@@ -1,0 +1,20 @@
+"""r2d2_tpu — a TPU-native (JAX/XLA/pjit) R2D2 distributed RL framework.
+
+A from-scratch re-design of the capabilities of ZiyuanMa/R2D2
+(Recurrent Experience Replay in Distributed RL, Kapturowski et al. 2019):
+Ape-X actor fleets, prioritised sequence replay with burn-in and stored
+recurrent state, dueling CNN+LSTM Q-networks, n-step double-Q targets under
+value rescaling — built TPU-first on jax.jit / jax.sharding / lax.scan.
+"""
+
+from r2d2_tpu.config import (
+    Config,
+    smoke_config,
+    pong_config,
+    hard_exploration_config,
+    atari57_config,
+    impala_deep_config,
+    test_config,
+)
+
+__version__ = "0.1.0"

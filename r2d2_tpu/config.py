@@ -1,0 +1,177 @@
+"""Typed configuration for the TPU-native R2D2 framework.
+
+Replaces the reference's flat module-global config (``/root/reference/config.py:1-37``)
+with an immutable dataclass: values are captured at construction, derived
+quantities are validated, and presets mirror the benchmark configurations in
+``BASELINE.json``.  Nothing reads config at import time; every component takes
+a ``Config`` explicitly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # --- environment -----------------------------------------------------
+    # reference: config.py:1-2 (game name, (1,84,84) CHW obs). We use NHWC
+    # (84,84,1) because that is the native TPU/XLA conv layout.
+    game_name: str = "MsPacman"
+    obs_shape: Tuple[int, int, int] = (84, 84, 1)
+    frameskip: int = 4
+    noop_max: int = 30
+    max_episode_steps: int = 27000  # reference: config.py:17
+
+    # --- optimisation ----------------------------------------------------
+    lr: float = 1e-4            # reference: config.py:4
+    adam_eps: float = 1e-3      # reference: config.py:5
+    grad_norm: float = 40.0     # reference: config.py:6
+    batch_size: int = 64        # reference: config.py:7
+    gamma: float = 0.997        # reference: config.py:11
+    training_steps: int = 100000  # reference: config.py:15
+
+    # --- prioritised replay ----------------------------------------------
+    prio_exponent: float = 0.9               # reference: config.py:12
+    importance_sampling_exponent: float = 0.6  # reference: config.py:13
+    learning_starts: int = 50000             # reference: config.py:8
+    buffer_capacity: int = 2_000_000         # reference: config.py:16 (transitions)
+    block_length: int = 400                  # reference: config.py:19
+
+    # --- sequence windows -------------------------------------------------
+    burn_in_steps: int = 40     # reference: config.py:27
+    learning_steps: int = 40    # reference: config.py:28
+    forward_steps: int = 5      # reference: config.py:29 (n-step bootstrap)
+
+    # --- actor fleet ------------------------------------------------------
+    num_actors: int = 8         # reference: config.py:21
+    base_eps: float = 0.4       # reference: config.py:22
+    eps_alpha: float = 7.0      # reference: config.py:23
+    actor_update_interval: int = 400  # reference: config.py:18
+
+    # --- cadences ---------------------------------------------------------
+    save_interval: int = 500               # reference: config.py:9
+    target_net_update_interval: int = 2000  # reference: config.py:10
+    weight_publish_interval: int = 4       # reference: worker.py:372
+    log_interval: float = 10.0             # reference: config.py:24
+
+    # --- network ----------------------------------------------------------
+    hidden_dim: int = 512       # reference: config.py:33
+    torso: str = "nature"       # "nature" (model.py:39-49) or "impala" (BASELINE configs[4])
+    lstm_layers: int = 1        # BASELINE configs[4] uses 2
+
+    # --- evaluation -------------------------------------------------------
+    test_epsilon: float = 0.001  # reference: config.py:37
+    eval_episodes: int = 5       # reference: test.py:17
+
+    # --- TPU-native knobs (no reference equivalent) -----------------------
+    compute_dtype: str = "bfloat16"   # activations dtype for conv/matmul
+    param_dtype: str = "float32"
+    remat: bool = False               # rematerialise the LSTM scan (long seq)
+    mesh_shape: Tuple[Tuple[str, int], ...] = ()  # e.g. (("dp", 4), ("mp", 2))
+    prefetch_batches: int = 4         # reference staging list depth, worker.py:312
+    seed: int = 0
+
+    # --- derived ----------------------------------------------------------
+    @property
+    def seq_len(self) -> int:
+        """reference: config.py:30 (burn_in + learning + forward)."""
+        return self.burn_in_steps + self.learning_steps + self.forward_steps
+
+    @property
+    def seqs_per_block(self) -> int:
+        """Sequences per block (reference: worker.py:48)."""
+        return self.block_length // self.learning_steps
+
+    @property
+    def num_blocks(self) -> int:
+        """Ring size in blocks (reference: worker.py:47)."""
+        return self.buffer_capacity // self.block_length
+
+    @property
+    def num_sequences(self) -> int:
+        """PER leaf count (reference: worker.py:45)."""
+        return self.buffer_capacity // self.learning_steps
+
+    @property
+    def max_block_steps(self) -> int:
+        """Max env steps stored per block incl. burn-in prefix and the final obs."""
+        return self.block_length + self.burn_in_steps + 1
+
+    def __post_init__(self):
+        if self.block_length % self.learning_steps != 0:
+            raise ValueError(
+                f"block_length ({self.block_length}) must be a multiple of "
+                f"learning_steps ({self.learning_steps})"
+            )
+        if self.buffer_capacity % self.block_length != 0:
+            raise ValueError("buffer_capacity must be a multiple of block_length")
+        if self.forward_steps < 1:
+            raise ValueError("forward_steps must be >= 1")
+        if self.num_actors < 1:
+            raise ValueError("num_actors must be >= 1")
+        if self.torso not in ("nature", "impala"):
+            raise ValueError(f"unknown torso {self.torso!r}")
+        if self.lstm_layers < 1:
+            raise ValueError("lstm_layers must be >= 1")
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+# --- presets mirroring BASELINE.json configs[0..4] ------------------------
+
+def smoke_config(**kw) -> Config:
+    """configs[0]: MsPacman, 1 actor, LSTM-512 CPU smoke."""
+    base = dict(game_name="MsPacman", num_actors=1)
+    base.update(kw)
+    return Config(**base)
+
+
+def pong_config(**kw) -> Config:
+    """configs[1]: Pong, 64 actors."""
+    base = dict(game_name="Pong", num_actors=64)
+    base.update(kw)
+    return Config(**base)
+
+
+def hard_exploration_config(game: str = "MontezumaRevenge", **kw) -> Config:
+    """configs[2]: hard-exploration Atari, 256 actors."""
+    base = dict(game_name=game, num_actors=256)
+    base.update(kw)
+    return Config(**base)
+
+
+def atari57_config(game: str, **kw) -> Config:
+    """configs[3]: Atari-57 sweep, 256 actors, seq-len 80 (paper hyperparams)."""
+    base = dict(
+        game_name=game, num_actors=256,
+        burn_in_steps=40, learning_steps=40, forward_steps=5,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def impala_deep_config(game: str = "MsPacman", **kw) -> Config:
+    """configs[4]: IMPALA-deep CNN + 2-layer LSTM, seq-len 120."""
+    base = dict(
+        game_name=game, torso="impala", lstm_layers=2,
+        burn_in_steps=40, learning_steps=75, forward_steps=5,
+        block_length=375, buffer_capacity=1_500_000, remat=True,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def test_config(**kw) -> Config:
+    """Tiny config for unit/integration tests: small windows, tiny buffer."""
+    base = dict(
+        obs_shape=(12, 12, 1),
+        burn_in_steps=4, learning_steps=4, forward_steps=2,
+        block_length=8, buffer_capacity=160, learning_starts=16,
+        batch_size=8, hidden_dim=16, num_actors=2,
+        max_episode_steps=50, training_steps=20,
+        compute_dtype="float32",
+    )
+    base.update(kw)
+    return Config(**base)

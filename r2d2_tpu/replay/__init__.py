@@ -1,0 +1,3 @@
+from r2d2_tpu.replay.sum_tree import SumTree
+from r2d2_tpu.replay.block import Block, LocalBuffer
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer

@@ -1,0 +1,186 @@
+"""Block wire format: the actor → replay unit of experience.
+
+Capability-parity with the reference's ``Block`` dataclass (worker.py:23-36)
+and ``LocalBuffer`` (worker.py:395-497): an episode is cut into blocks of up
+to ``block_length`` env steps; each block carries the observation stream
+*including a burn-in prefix carried over from the previous block*, per-step
+n-step returns and bootstrap discounts (terminality encoded as a zero
+discount tail instead of done flags), stored recurrent states at sequence
+starts, per-sequence window sizes, and actor-computed initial priorities.
+
+Intentional divergence from the reference: stored hidden states are recorded
+at each sequence's **burn-in start** (the R2D2 paper's scheme).  The
+reference samples them at ``i * learning_steps`` into the buffer
+(worker.py:461), which for blocks whose carried burn-in prefix is shorter
+than ``burn_in_steps`` (i.e. the first block of every episode) feeds a state
+recorded *after* the burn-in window it is unrolled over.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.utils.math import mixed_td_errors, n_step_gamma_tail, n_step_return
+
+
+@dataclasses.dataclass
+class Block:
+    """One actor-produced chunk of experience.
+
+    Array shapes (S = env steps in the block, P = burn-in prefix length,
+    K = number of sequences, A = action dim, layers/H = LSTM geometry):
+
+    - ``obs``:          (P + S + 1, *obs_shape) uint8 — includes next-obs tail
+    - ``last_action``:  (P + S + 1, A) bool (one-hot)
+    - ``last_reward``:  (P + S + 1,) float32
+    - ``action``:       (S,) uint8
+    - ``n_step_reward``:(S,) float32
+    - ``n_step_gamma``: (S,) float32 — 0 tail encodes terminal
+    - ``hidden``:       (K, 2, layers, H) float32 — state at burn-in start
+    - ``burn_in_steps``/``learning_steps``/``forward_steps``: (K,) uint8
+    """
+    obs: np.ndarray
+    last_action: np.ndarray
+    last_reward: np.ndarray
+    action: np.ndarray
+    n_step_reward: np.ndarray
+    n_step_gamma: np.ndarray
+    hidden: np.ndarray
+    num_sequences: int
+    burn_in_steps: np.ndarray
+    learning_steps: np.ndarray
+    forward_steps: np.ndarray
+
+
+class LocalBuffer:
+    """Actor-side accumulator that cuts episodes into Blocks.
+
+    Mirrors the reference's LocalBuffer lifecycle (worker.py:413-497):
+    ``reset`` at episode start, ``add`` once per env step, ``finish`` at
+    episode end / block boundary / episode-step cap.  ``finish`` retains the
+    trailing ``burn_in_steps + 1`` entries so the next block of the same
+    episode starts with a warm burn-in prefix.
+    """
+
+    def __init__(self, cfg: Config, action_dim: int):
+        self.cfg = cfg
+        self.action_dim = action_dim
+        self.hidden_shape = (2, cfg.lstm_layers, cfg.hidden_dim)
+        self.curr_burn_in_steps = 0
+        self.size = 0
+
+    def __len__(self) -> int:
+        return self.size
+
+    def reset(self, init_obs: np.ndarray) -> None:
+        noop_one_hot = np.zeros(self.action_dim, dtype=bool)
+        noop_one_hot[0] = True
+        self.obs_buffer: List[np.ndarray] = [np.asarray(init_obs, dtype=np.uint8)]
+        self.last_action_buffer: List[np.ndarray] = [noop_one_hot]
+        self.last_reward_buffer: List[float] = [0.0]
+        self.hidden_buffer: List[np.ndarray] = [np.zeros(self.hidden_shape, np.float32)]
+        self.action_buffer: List[int] = []
+        self.reward_buffer: List[float] = []
+        self.qval_buffer: List[np.ndarray] = []
+        self.curr_burn_in_steps = 0
+        self.size = 0
+        self.sum_reward = 0.0
+        self.done = False
+
+    def add(self, action: int, reward: float, next_obs: np.ndarray,
+            q_value: np.ndarray, hidden: np.ndarray) -> None:
+        """Record one env step.  ``hidden`` is the recurrent state *after*
+        consuming the obs that produced ``q_value`` (so buffers stay aligned:
+        entry i is the state with which obs i is consumed)."""
+        one_hot = np.zeros(self.action_dim, dtype=bool)
+        one_hot[action] = True
+        self.action_buffer.append(action)
+        self.reward_buffer.append(reward)
+        self.obs_buffer.append(np.asarray(next_obs, dtype=np.uint8))
+        self.last_action_buffer.append(one_hot)
+        self.last_reward_buffer.append(reward)
+        self.hidden_buffer.append(np.asarray(hidden, np.float32).reshape(self.hidden_shape))
+        self.qval_buffer.append(np.asarray(q_value, np.float32).reshape(self.action_dim))
+        self.sum_reward += reward
+        self.size += 1
+
+    def finish(self, last_qval: Optional[np.ndarray] = None
+               ) -> Tuple[Block, np.ndarray, Optional[float]]:
+        """Close the current chunk into a Block.
+
+        ``last_qval=None`` means the episode terminated (bootstrap discount
+        tail is zeroed); otherwise it is the Q-value at the final obs, used to
+        bootstrap a truncated chunk (worker.py:443-453).
+
+        Returns ``(block, per-leaf priorities, episode_reward or None)``.
+        """
+        cfg = self.cfg
+        assert 0 < self.size <= cfg.block_length
+        size, L, n = self.size, cfg.learning_steps, cfg.forward_steps
+        c = self.curr_burn_in_steps
+        num_sequences = math.ceil(size / L)
+        self.done = last_qval is None
+
+        qvals = list(self.qval_buffer)
+        if self.done:
+            qvals.append(np.zeros(self.action_dim, np.float32))
+        else:
+            qvals.append(np.asarray(last_qval, np.float32).reshape(self.action_dim))
+        qvals = np.stack(qvals)                       # (size+1, A)
+
+        gamma_tail = n_step_gamma_tail(size, n, cfg.gamma, self.done)
+        nstep_r = n_step_return(np.asarray(self.reward_buffer, np.float32), n, cfg.gamma)
+
+        obs = np.stack(self.obs_buffer)
+        last_action = np.stack(self.last_action_buffer)
+        last_reward = np.asarray(self.last_reward_buffer, np.float32)
+        actions = np.asarray(self.action_buffer, np.uint8)
+
+        # per-sequence window sizes (worker.py:471-474 invariants)
+        seq_ids = np.arange(num_sequences)
+        burn_in = np.minimum(seq_ids * L + c, cfg.burn_in_steps).astype(np.uint8)
+        learning = np.minimum(L, size - seq_ids * L).astype(np.uint8)
+        forward = np.minimum(n, size + 1 - np.cumsum(learning)).astype(np.uint8)
+        assert forward[-1] == 1 and burn_in[0] == min(c, cfg.burn_in_steps)
+
+        # recurrent state at each sequence's burn-in start (paper-correct; see
+        # module docstring for the divergence from worker.py:461)
+        hidden_idx = c + seq_ids * L - burn_in.astype(np.int64)
+        hiddens = np.stack([self.hidden_buffer[i] for i in hidden_idx])
+
+        # actor-side initial priorities: plain max-Q n-step TD, no value
+        # rescale and no double-Q — replicating the reference's asymmetry
+        # vs the learner (worker.py:477-483)
+        max_forward = min(size, n)
+        max_q = qvals[max_forward:size + 1].max(axis=1)
+        max_q = np.pad(max_q, (0, max_forward - 1), mode="edge")
+        taken_q = qvals[np.arange(size), actions]
+        td = np.abs(nstep_r + gamma_tail * max_q - taken_q).astype(np.float32)
+        priorities = np.zeros(cfg.seqs_per_block, np.float32)
+        priorities[:num_sequences] = mixed_td_errors(td, learning)
+
+        block = Block(
+            obs=obs, last_action=last_action, last_reward=last_reward,
+            action=actions, n_step_reward=nstep_r, n_step_gamma=gamma_tail,
+            hidden=hiddens.astype(np.float32), num_sequences=num_sequences,
+            burn_in_steps=burn_in, learning_steps=learning, forward_steps=forward,
+        )
+        episode_reward = self.sum_reward if self.done else None
+
+        # carry the burn-in prefix into the next block (worker.py:486-493)
+        keep = cfg.burn_in_steps + 1
+        self.obs_buffer = self.obs_buffer[-keep:]
+        self.last_action_buffer = self.last_action_buffer[-keep:]
+        self.last_reward_buffer = self.last_reward_buffer[-keep:]
+        self.hidden_buffer = self.hidden_buffer[-keep:]
+        self.action_buffer.clear()
+        self.reward_buffer.clear()
+        self.qval_buffer.clear()
+        self.curr_burn_in_steps = len(self.obs_buffer) - 1
+        self.size = 0
+
+        return block, priorities, episode_reward

@@ -1,0 +1,197 @@
+"""Prioritised sequence replay buffer (host-side data plane).
+
+Capability-parity with the reference's ``ReplayBuffer`` (worker.py:38-261):
+a ring of blocks with one PER leaf per learning sequence, stratified
+prioritised sampling, IS weights, stale-index masking when leaves are
+overwritten between sampling and the learner's priority feedback, and
+size/env-step/episode-return accounting.
+
+TPU-first redesign vs the reference: blocks live in **preallocated
+contiguous ring arrays** instead of a Python list of ragged objects, so a
+64-sequence batch is assembled by a handful of vectorised fancy-index
+gathers into fixed-shape ``(B, T, ...)`` numpy arrays (replacing the
+per-sample Python slicing loop + ``pad_sequence`` at worker.py:176-214).
+Fixed shapes mean the jitted learner step compiles once; the gather is the
+whole batch cost, which is what lets the host feed a TPU-rate learner.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.replay.block import Block
+from r2d2_tpu.replay.sum_tree import SumTree
+
+
+class ReplayBuffer:
+    """Synchronous core. Thread-safe via one lock; process/queue plumbing
+    lives in :mod:`r2d2_tpu.train` so this class stays directly testable."""
+
+    def __init__(self, cfg: Config, action_dim: int,
+                 rng: Optional[np.random.Generator] = None):
+        self.cfg = cfg
+        self.action_dim = action_dim
+        NB, K, MS = cfg.num_blocks, cfg.seqs_per_block, cfg.max_block_steps
+        BL, layers, H = cfg.block_length, cfg.lstm_layers, cfg.hidden_dim
+
+        self.obs = np.zeros((NB, MS, *cfg.obs_shape), np.uint8)
+        self.last_action = np.zeros((NB, MS, action_dim), bool)
+        self.last_reward = np.zeros((NB, MS), np.float32)
+        self.action = np.zeros((NB, BL), np.uint8)
+        self.n_step_reward = np.zeros((NB, BL), np.float32)
+        self.n_step_gamma = np.zeros((NB, BL), np.float32)
+        self.hidden = np.zeros((NB, K, 2, layers, H), np.float32)
+        self.burn_in_steps = np.zeros((NB, K), np.uint8)
+        self.learning_steps = np.zeros((NB, K), np.uint8)
+        self.forward_steps = np.zeros((NB, K), np.uint8)
+        self.first_burn_in = np.zeros(NB, np.int64)
+        self.block_learning_total = np.zeros(NB, np.int64)
+
+        self.tree = SumTree(cfg.num_sequences, cfg.prio_exponent,
+                            cfg.importance_sampling_exponent, rng=rng)
+
+        self.lock = threading.Lock()
+        self.block_ptr = 0
+        self.size = 0            # total learning steps stored (reference "size")
+        self.env_steps = 0
+        self.num_episodes = 0
+        self.episode_reward = 0.0
+        self.training_steps = 0
+        self.sum_loss = 0.0
+
+    def __len__(self) -> int:
+        return self.size
+
+    @property
+    def ready(self) -> bool:
+        return self.size >= self.cfg.learning_starts
+
+    # ------------------------------------------------------------------ add
+    def add(self, block: Block, priorities: np.ndarray,
+            episode_reward: Optional[float]) -> None:
+        """Overwrite the ring slot at ``block_ptr`` (worker.py:141-161)."""
+        cfg = self.cfg
+        K = cfg.seqs_per_block
+        with self.lock:
+            ptr = self.block_ptr
+            leaf_idxes = np.arange(ptr * K, (ptr + 1) * K, dtype=np.int64)
+            self.tree.update(leaf_idxes, priorities)
+
+            self.size -= int(self.block_learning_total[ptr])
+
+            n_obs = block.obs.shape[0]
+            n_steps = block.action.shape[0]
+            k = block.num_sequences
+            self.obs[ptr, :n_obs] = block.obs
+            self.last_action[ptr, :n_obs] = block.last_action
+            self.last_reward[ptr, :n_obs] = block.last_reward
+            self.action[ptr, :n_steps] = block.action
+            self.n_step_reward[ptr, :n_steps] = block.n_step_reward
+            self.n_step_gamma[ptr, :n_steps] = block.n_step_gamma
+            self.hidden[ptr, :k] = block.hidden
+            self.burn_in_steps[ptr] = 0
+            self.learning_steps[ptr] = 0
+            self.forward_steps[ptr] = 0
+            self.burn_in_steps[ptr, :k] = block.burn_in_steps
+            self.learning_steps[ptr, :k] = block.learning_steps
+            self.forward_steps[ptr, :k] = block.forward_steps
+            self.first_burn_in[ptr] = int(block.burn_in_steps[0])
+
+            total = int(block.learning_steps.sum())
+            self.block_learning_total[ptr] = total
+            self.size += total
+            self.env_steps += total
+
+            self.block_ptr = (ptr + 1) % cfg.num_blocks
+            if episode_reward is not None:
+                self.episode_reward += episode_reward
+                self.num_episodes += 1
+
+    # --------------------------------------------------------------- sample
+    def sample_batch(self, batch_size: Optional[int] = None) -> Dict[str, np.ndarray]:
+        """Assemble one fixed-shape training batch.
+
+        Returns a dict of arrays (B = batch, T = seq_len, L = learning_steps):
+        obs (B,T,*obs) u8 · last_action (B,T,A) f32 · last_reward (B,T) f32 ·
+        hidden (B,2,layers,H) · action (B,L) i32 · n_step_reward/gamma (B,L) ·
+        burn_in/learning/forward (B,) i32 · is_weights (B,) f32, plus host-only
+        bookkeeping: idxes, block_ptr snapshot, env_steps (worker.py:219-238).
+        """
+        cfg = self.cfg
+        B = batch_size or cfg.batch_size
+        K, L, T = cfg.seqs_per_block, cfg.learning_steps, cfg.seq_len
+        with self.lock:
+            idxes, is_weights = self.tree.sample(B)
+            block_idx = idxes // K
+            seq_idx = idxes % K
+
+            burn_in = self.burn_in_steps[block_idx, seq_idx].astype(np.int64)
+            learning = self.learning_steps[block_idx, seq_idx].astype(np.int64)
+            forward = self.forward_steps[block_idx, seq_idx].astype(np.int64)
+
+            # obs-coordinate window start: first burn-in prefix + k full
+            # learning windows (worker.py:186), reaching back over this
+            # sequence's own burn-in
+            start = self.first_burn_in[block_idx] + seq_idx * L
+            t0 = start - burn_in
+            time_idx = np.minimum(t0[:, None] + np.arange(T), cfg.max_block_steps - 1)
+            bcol = block_idx[:, None]
+            obs = self.obs[bcol, time_idx]
+            last_action = self.last_action[bcol, time_idx].astype(np.float32)
+            last_reward = self.last_reward[bcol, time_idx]
+
+            widx = np.minimum(seq_idx[:, None] * L + np.arange(L), cfg.block_length - 1)
+            action = self.action[bcol, widx].astype(np.int32)
+            n_step_reward = self.n_step_reward[bcol, widx]
+            n_step_gamma = self.n_step_gamma[bcol, widx]
+            hidden = self.hidden[block_idx, seq_idx]
+
+            batch = dict(
+                obs=obs, last_action=last_action, last_reward=last_reward,
+                hidden=hidden, action=action,
+                n_step_reward=n_step_reward, n_step_gamma=n_step_gamma,
+                burn_in=burn_in.astype(np.int32),
+                learning=learning.astype(np.int32),
+                forward=forward.astype(np.int32),
+                is_weights=is_weights.astype(np.float32),
+                idxes=idxes,
+                block_ptr=self.block_ptr,
+                env_steps=self.env_steps,
+            )
+        return batch
+
+    # ------------------------------------------------------- priority update
+    def update_priorities(self, idxes: np.ndarray, priorities: np.ndarray,
+                          old_ptr: int, loss: float) -> None:
+        """Write back learner priorities, discarding indices whose ring slots
+        were overwritten since the batch was sampled (worker.py:242-261)."""
+        K = self.cfg.seqs_per_block
+        with self.lock:
+            new_ptr = self.block_ptr
+            if new_ptr > old_ptr:
+                mask = (idxes < old_ptr * K) | (idxes >= new_ptr * K)
+            elif new_ptr < old_ptr:
+                mask = (idxes < old_ptr * K) & (idxes >= new_ptr * K)
+            else:
+                mask = np.ones_like(idxes, dtype=bool)
+            self.tree.update(idxes[mask], priorities[mask])
+            self.training_steps += 1
+            self.sum_loss += float(loss)
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, float]:
+        with self.lock:
+            s = dict(
+                size=self.size, env_steps=self.env_steps,
+                training_steps=self.training_steps,
+                num_episodes=self.num_episodes,
+                episode_reward=self.episode_reward,
+                sum_loss=self.sum_loss,
+            )
+            self.episode_reward = 0.0
+            self.num_episodes = 0
+            self.sum_loss = 0.0
+        return s

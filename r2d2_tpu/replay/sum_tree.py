@@ -1,0 +1,82 @@
+"""Vectorised prioritised-replay sum tree (host-side numpy).
+
+Same capability as the reference's ``PriorityTree`` (priority_tree.py:4-45):
+flat-array binary sum tree, batched leaf updates with level-by-level upward
+propagation, stratified proportional sampling with a vectorised top-down
+descent, and min-normalised importance-sampling weights.  Stays on the host by
+design — it is O(log n) pointer-chasing, the wrong shape for the MXU; the
+TPU sees only the resulting batch indices/weights.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class SumTree:
+    def __init__(self, capacity: int, prio_exponent: float, is_exponent: float,
+                 rng: Optional[np.random.Generator] = None):
+        self.capacity = capacity
+        # number of levels so that the leaf layer has >= capacity slots
+        self.num_levels = 1
+        while 2 ** (self.num_levels - 1) < capacity:
+            self.num_levels += 1
+        self.leaf_offset = 2 ** (self.num_levels - 1) - 1
+        self.nodes = np.zeros(2 ** self.num_levels - 1, dtype=np.float64)
+        self.prio_exponent = prio_exponent
+        self.is_exponent = is_exponent
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    @property
+    def total(self) -> float:
+        return float(self.nodes[0])
+
+    def update(self, idxes: np.ndarray, td_errors: np.ndarray) -> None:
+        """Set leaf priorities to ``td**alpha`` and repair ancestor sums.
+
+        Batched: each tree level is repaired once for the unique set of touched
+        parents (reference: priority_tree.py:15-24).
+        """
+        idxes = np.asarray(idxes, dtype=np.int64)
+        if idxes.size == 0:
+            return
+        prios = np.asarray(td_errors, dtype=np.float64) ** self.prio_exponent
+        nodes = idxes + self.leaf_offset
+        self.nodes[nodes] = prios
+        for _ in range(self.num_levels - 1):
+            nodes = np.unique((nodes - 1) // 2)
+            self.nodes[nodes] = self.nodes[2 * nodes + 1] + self.nodes[2 * nodes + 2]
+
+    def sample(self, num_samples: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Stratified proportional sample of ``num_samples`` leaves.
+
+        The total mass is split into equal strata with one uniform draw each,
+        then all descents run lock-step vectorised (priority_tree.py:26-44).
+        Returns (leaf indices, IS weights).  Weights are ``(p/min_p)^-beta``
+        normalised by the minimum *sampled* priority, so they lie in (0, 1]
+        — the reference's scheme, which avoids a global min-tree.
+        """
+        total = self.nodes[0]
+        if total <= 0:
+            raise ValueError("cannot sample from an empty tree")
+        interval = total / num_samples
+        targets = interval * np.arange(num_samples, dtype=np.float64)
+        targets += self.rng.uniform(0.0, interval, num_samples)
+
+        nodes = np.zeros(num_samples, dtype=np.int64)
+        for _ in range(self.num_levels - 1):
+            left = 2 * nodes + 1
+            left_mass = self.nodes[left]
+            go_right = targets >= left_mass
+            nodes = np.where(go_right, left + 1, left)
+            targets = np.where(go_right, targets - left_mass, targets)
+
+        prios = self.nodes[nodes]
+        # numerical guard: a descent can land on a zero leaf when float error
+        # accumulates; clamp to the smallest positive sampled priority
+        pos = prios[prios > 0]
+        min_p = pos.min() if pos.size else 1.0
+        prios = np.maximum(prios, min_p)
+        is_weights = (prios / min_p) ** (-self.is_exponent)
+        return nodes - self.leaf_offset, is_weights

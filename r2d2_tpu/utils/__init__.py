@@ -1,0 +1,8 @@
+from r2d2_tpu.utils.math import (
+    value_rescale,
+    inverse_value_rescale,
+    n_step_return,
+    n_step_gamma_tail,
+    epsilon_ladder,
+    mixed_td_errors,
+)

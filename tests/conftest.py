@@ -1,0 +1,14 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+
+This is the JAX-native way to test multi-chip sharding without hardware
+(SURVEY.md §4): all tests run on CPU with 8 fake devices so pjit/Mesh code
+paths execute real collectives.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

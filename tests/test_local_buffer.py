@@ -1,0 +1,119 @@
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.replay.block import LocalBuffer
+
+
+CFG = make_test_config()  # burn_in 4, learning 4, forward 2, block_length 8
+A = 3
+
+
+def run_steps(lb, n, rng, reward=1.0):
+    for _ in range(n):
+        obs = rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8)
+        q = rng.normal(size=A).astype(np.float32)
+        h = rng.normal(size=(2, CFG.lstm_layers, CFG.hidden_dim)).astype(np.float32)
+        lb.add(int(rng.integers(A)), reward, obs, q, h)
+
+
+def fresh(rng):
+    lb = LocalBuffer(CFG, A)
+    lb.reset(rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8))
+    return lb
+
+
+def test_full_block_invariants():
+    rng = np.random.default_rng(0)
+    lb = fresh(rng)
+    run_steps(lb, CFG.block_length, rng)
+    block, prios, ep_reward = lb.finish(last_qval=np.ones(A, np.float32))
+
+    assert block.num_sequences == 2
+    np.testing.assert_array_equal(block.learning_steps, [4, 4])
+    np.testing.assert_array_equal(block.burn_in_steps, [0, 4])
+    # forward_steps invariant (worker.py:474): last sequence has exactly 1
+    assert block.forward_steps[-1] == 1
+    np.testing.assert_array_equal(block.forward_steps, [2, 1])
+    assert block.obs.shape == (9, *CFG.obs_shape)  # size+1, no prefix yet
+    assert block.action.shape == (8,)
+    assert prios.shape == (CFG.seqs_per_block,)
+    assert (prios > 0).all()
+    assert ep_reward is None  # truncated, not done
+
+
+def test_terminal_gamma_tail_and_episode_reward():
+    rng = np.random.default_rng(1)
+    lb = fresh(rng)
+    run_steps(lb, 6, rng, reward=2.0)
+    block, _, ep_reward = lb.finish(last_qval=None)
+    assert ep_reward == pytest.approx(12.0)
+    # last min(size, n)=2 discounts zeroed (terminal encoding, worker.py:447-453)
+    np.testing.assert_allclose(block.n_step_gamma[-2:], 0.0)
+    np.testing.assert_allclose(block.n_step_gamma[:-2], CFG.gamma ** CFG.forward_steps)
+
+
+def test_burn_in_carryover():
+    rng = np.random.default_rng(2)
+    lb = fresh(rng)
+    run_steps(lb, CFG.block_length, rng)
+    first_obs_tail = np.stack(lb.obs_buffer[-(CFG.burn_in_steps + 1):])
+    lb.finish(last_qval=np.zeros(A, np.float32))
+    assert lb.curr_burn_in_steps == CFG.burn_in_steps
+
+    run_steps(lb, CFG.block_length, rng)
+    block2, _, _ = lb.finish(last_qval=np.zeros(A, np.float32))
+    # second block carries burn-in prefix: obs length = prefix + size + 1
+    assert block2.obs.shape[0] == CFG.burn_in_steps + CFG.block_length + 1
+    assert block2.burn_in_steps[0] == CFG.burn_in_steps
+    np.testing.assert_array_equal(block2.obs[:CFG.burn_in_steps + 1], first_obs_tail)
+
+
+def test_hidden_stored_at_burn_in_start():
+    """Stored hidden must be the state at each sequence's burn-in start
+    (paper-correct; intentional fix of the reference's worker.py:461)."""
+    rng = np.random.default_rng(3)
+    lb = fresh(rng)
+    hiddens_fed = [np.zeros((2, CFG.lstm_layers, CFG.hidden_dim), np.float32)]
+    for _ in range(CFG.block_length):
+        obs = rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8)
+        h = rng.normal(size=(2, CFG.lstm_layers, CFG.hidden_dim)).astype(np.float32)
+        lb.add(0, 0.0, obs, np.zeros(A, np.float32), h)
+        hiddens_fed.append(h)
+    block, _, _ = lb.finish(last_qval=np.zeros(A, np.float32))
+    # first block of episode: c=0. seq 0: burn_in=0, start=0 -> hidden[0]
+    np.testing.assert_array_equal(block.hidden[0], hiddens_fed[0])
+    # seq 1: learning starts at step 4, burn_in=4 -> state at step 0
+    np.testing.assert_array_equal(block.hidden[1], hiddens_fed[0])
+
+    # next block: c=4, seq 0 burn-in start is obs index 0 of retained prefix
+    prefix_state = lb.hidden_buffer[0]
+    run_steps(lb, CFG.block_length, rng)
+    block2, _, _ = lb.finish(last_qval=np.zeros(A, np.float32))
+    np.testing.assert_array_equal(block2.hidden[0], prefix_state)
+
+
+def test_partial_final_sequence_counts():
+    rng = np.random.default_rng(4)
+    lb = fresh(rng)
+    run_steps(lb, 6, rng)  # 1.5 sequences
+    block, prios, _ = lb.finish(last_qval=np.zeros(A, np.float32))
+    np.testing.assert_array_equal(block.learning_steps, [4, 2])
+    assert block.forward_steps[-1] == 1
+    # unused leaf slots get zero priority so they are never sampled
+    assert prios[block.num_sequences:].sum() == 0
+
+
+def test_n_step_reward_alignment():
+    rng = np.random.default_rng(5)
+    lb = fresh(rng)
+    rewards = [1.0, 0.0, 0.0, 2.0, 0.0, 0.0, 0.0, 3.0]
+    for r in rewards:
+        obs = rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8)
+        lb.add(0, r, obs, np.zeros(A, np.float32),
+               np.zeros((2, CFG.lstm_layers, CFG.hidden_dim), np.float32))
+    block, _, _ = lb.finish(last_qval=None)
+    g, n = CFG.gamma, CFG.forward_steps
+    for t in range(8):
+        expected = sum(g ** i * rewards[t + i] for i in range(n) if t + i < 8)
+        np.testing.assert_allclose(block.n_step_reward[t], expected, rtol=1e-5)

@@ -1,0 +1,75 @@
+import numpy as np
+import pytest
+
+from r2d2_tpu.utils.math import (
+    epsilon_ladder,
+    inverse_value_rescale,
+    mixed_td_errors,
+    n_step_gamma_tail,
+    n_step_return,
+    value_rescale,
+)
+
+
+def test_value_rescale_round_trip():
+    x = np.linspace(-500, 500, 2001)
+    np.testing.assert_allclose(inverse_value_rescale(value_rescale(x)), x,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(value_rescale(inverse_value_rescale(x)), x,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_value_rescale_known_values():
+    # h(0)=0, h(3)=1+3eps, odd symmetry
+    assert value_rescale(np.array(0.0)) == 0.0
+    np.testing.assert_allclose(value_rescale(np.array(3.0)), 1.0 + 3e-3)
+    x = np.array([1.7, 42.0])
+    np.testing.assert_allclose(value_rescale(-x), -value_rescale(x))
+
+
+def test_n_step_return_matches_naive():
+    rng = np.random.default_rng(0)
+    rewards = rng.normal(size=37)
+    n, gamma = 5, 0.997
+    out = n_step_return(rewards, n, gamma)
+    assert out.shape == (37,)
+    for t in range(37):
+        expected = sum(gamma ** i * rewards[t + i] for i in range(n) if t + i < 37)
+        np.testing.assert_allclose(out[t], expected, rtol=1e-5)
+
+
+def test_n_step_gamma_tail_terminal_and_truncated():
+    n, gamma = 5, 0.9
+    term = n_step_gamma_tail(8, n, gamma, terminal=True)
+    np.testing.assert_allclose(term[:3], gamma ** n)
+    np.testing.assert_allclose(term[3:], 0.0)
+
+    trunc = n_step_gamma_tail(8, n, gamma, terminal=False)
+    np.testing.assert_allclose(trunc[:3], gamma ** n)
+    np.testing.assert_allclose(trunc[3:], [gamma ** 5, gamma ** 4, gamma ** 3,
+                                           gamma ** 2, gamma ** 1], rtol=1e-6)
+    # chunk shorter than n
+    short = n_step_gamma_tail(3, n, gamma, terminal=False)
+    np.testing.assert_allclose(short, [gamma ** 3, gamma ** 2, gamma], rtol=1e-6)
+
+
+def test_epsilon_ladder_matches_apex_formula():
+    # reference: train.py:15-17 with base 0.4, alpha 7, N=8
+    eps = [epsilon_ladder(i, 8) for i in range(8)]
+    np.testing.assert_allclose(eps[0], 0.4)
+    np.testing.assert_allclose(eps[7], 0.4 ** 8)
+    assert all(a > b for a, b in zip(eps, eps[1:]))
+    assert epsilon_ladder(0, 1) == 0.4  # single actor: no ladder
+
+
+def test_mixed_td_errors_matches_naive_loop():
+    rng = np.random.default_rng(1)
+    learning_steps = np.array([4, 4, 2, 1], dtype=np.int64)
+    td = rng.uniform(0.1, 2.0, learning_steps.sum()).astype(np.float32)
+    out = mixed_td_errors(td, learning_steps)
+    start = 0
+    for i, steps in enumerate(learning_steps):
+        seg = td[start:start + steps]
+        np.testing.assert_allclose(out[i], 0.9 * seg.max() + 0.1 * seg.mean(),
+                                   rtol=1e-6)
+        start += steps

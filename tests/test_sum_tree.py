@@ -1,0 +1,64 @@
+import numpy as np
+import pytest
+
+from r2d2_tpu.replay.sum_tree import SumTree
+
+
+def make_tree(capacity=64, alpha=0.9, beta=0.6, seed=0):
+    return SumTree(capacity, alpha, beta, rng=np.random.default_rng(seed))
+
+
+def test_update_sets_leaf_priorities_and_total():
+    t = make_tree()
+    idx = np.array([0, 3, 10])
+    td = np.array([1.0, 2.0, 0.5])
+    t.update(idx, td)
+    expected = (td ** 0.9).sum()
+    np.testing.assert_allclose(t.total, expected, rtol=1e-12)
+
+
+def test_update_overwrite_repairs_sums():
+    t = make_tree()
+    t.update(np.arange(8), np.ones(8))
+    t.update(np.array([2]), np.array([5.0]))
+    expected = 7 * 1.0 + 5.0 ** 0.9
+    np.testing.assert_allclose(t.total, expected, rtol=1e-12)
+
+
+def test_sampling_is_proportional():
+    t = make_tree(capacity=8, alpha=1.0, seed=42)
+    prios = np.array([1.0, 2.0, 4.0, 8.0, 0.0, 0.0, 1.0, 0.0])
+    t.update(np.arange(8), prios)
+    counts = np.zeros(8)
+    for _ in range(400):
+        idx, _ = t.sample(16)
+        np.testing.assert_array_less(idx, 8)
+        counts += np.bincount(idx, minlength=8)
+    freq = counts / counts.sum()
+    np.testing.assert_allclose(freq, prios / prios.sum(), atol=0.02)
+    assert counts[4] == counts[5] == counts[7] == 0  # zero-priority leaves
+
+
+def test_is_weights_min_normalised():
+    t = make_tree(capacity=4, alpha=1.0, beta=0.6, seed=3)
+    t.update(np.arange(4), np.array([1.0, 2.0, 4.0, 8.0]))
+    idx, w = t.sample(64)
+    assert w.max() <= 1.0 + 1e-12
+    # weight of the min sampled priority is exactly 1
+    sampled_prios = np.array([t.nodes[t.leaf_offset + i] for i in idx])
+    np.testing.assert_allclose(w, (sampled_prios / sampled_prios.min()) ** -0.6)
+
+
+def test_stratification_covers_mass():
+    # with equal priorities and num_samples == capacity, stratified sampling
+    # picks every leaf exactly once
+    t = make_tree(capacity=16, alpha=1.0, seed=7)
+    t.update(np.arange(16), np.ones(16))
+    idx, _ = t.sample(16)
+    assert sorted(idx.tolist()) == list(range(16))
+
+
+def test_empty_tree_raises():
+    t = make_tree()
+    with pytest.raises(ValueError):
+        t.sample(4)
