@@ -110,7 +110,7 @@ class Config:
             raise ValueError("forward_steps must be >= 1")
         if self.num_actors < 1:
             raise ValueError("num_actors must be >= 1")
-        if self.torso not in ("nature", "impala"):
+        if self.torso not in ("nature", "impala", "mlp"):
             raise ValueError(f"unknown torso {self.torso!r}")
         if self.lstm_layers < 1:
             raise ValueError("lstm_layers must be >= 1")
@@ -166,7 +166,7 @@ def impala_deep_config(game: str = "MsPacman", **kw) -> Config:
 def test_config(**kw) -> Config:
     """Tiny config for unit/integration tests: small windows, tiny buffer."""
     base = dict(
-        obs_shape=(12, 12, 1),
+        obs_shape=(12, 12, 1), torso="mlp",
         burn_in_steps=4, learning_steps=4, forward_steps=2,
         block_length=8, buffer_capacity=160, learning_starts=16,
         batch_size=8, hidden_dim=16, num_actors=2,

@@ -1,0 +1,243 @@
+"""Dueling CNN+LSTM Q-network, TPU-native.
+
+Capability-parity with the reference's ``Network`` (model.py:27-150): Nature
+conv torso → LSTM over [latent ⊕ one-hot last action ⊕ last reward] → dueling
+heads, with a single-step acting path and full-sequence training paths.
+
+TPU-first redesign:
+- NHWC layout (XLA's native conv layout) instead of torch NCHW.
+- The LSTM is a fused cell under ``jax.lax.scan`` with the input projection
+  hoisted out of the scan into one large ``(B*T, F) @ (F, 4H)`` MXU matmul;
+  only the small recurrent matmul stays sequential.
+- No ``pack_padded_sequence`` emulation: the unroll is static-shape over the
+  full padded T; per-sample window extraction is a masked gather done by the
+  learner (r2d2_tpu/learner/step.py), replacing the reference's per-sample
+  Python loops (model.py:95-111,143).
+- One ``unroll`` serves all three reference forward variants (model.py:65,
+  81, 122): acting is a T=1 unroll; online/target training Q are gathers at
+  different time indices of the same unrolled Q sequence.
+- ``impala`` torso (deep residual CNN) and stacked LSTM layers cover the
+  scaled-model benchmark config; ``mlp`` torso supports fast tests.
+- Optional rematerialisation of the scan body for long unrolls.
+
+Recurrent state wire format everywhere: ``(B, 2, layers, H)`` float32 where
+axis 1 is (h, c).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from r2d2_tpu.config import Config
+
+
+def _dtype(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
+
+
+class NatureTorso(nn.Module):
+    """Nature-DQN conv stack (reference geometry: model.py:39-49), NHWC."""
+    out_dim: int
+    compute_dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: (B, H, W, C) in [0, 1]
+        kw = dict(padding="VALID", dtype=self.compute_dtype,
+                  param_dtype=self.param_dtype)
+        x = nn.relu(nn.Conv(32, (8, 8), strides=(4, 4), **kw)(x))
+        x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2), **kw)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), **kw)(x))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.out_dim, dtype=self.compute_dtype,
+                             param_dtype=self.param_dtype)(x))
+        return x
+
+
+class ImpalaTorso(nn.Module):
+    """IMPALA deep residual CNN (BASELINE configs[4] scaled-model stress)."""
+    out_dim: int
+    compute_dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    channels: Tuple[int, ...] = (16, 32, 32)
+    blocks_per_stage: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(padding="SAME", dtype=self.compute_dtype,
+                  param_dtype=self.param_dtype)
+        for ch in self.channels:
+            x = nn.Conv(ch, (3, 3), **kw)(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for _ in range(self.blocks_per_stage):
+                skip = x
+                x = nn.Conv(ch, (3, 3), **kw)(nn.relu(x))
+                x = nn.Conv(ch, (3, 3), **kw)(nn.relu(x))
+                x = x + skip
+        x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.out_dim, dtype=self.compute_dtype,
+                             param_dtype=self.param_dtype)(x))
+        return x
+
+
+class MlpTorso(nn.Module):
+    """Small flatten+dense torso for tests and non-image observations."""
+    out_dim: int
+    compute_dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.out_dim, dtype=self.compute_dtype,
+                             param_dtype=self.param_dtype)(x))
+        return x
+
+
+class LSTMLayer(nn.Module):
+    """Fused LSTM layer scanned over time.
+
+    The input projection for all T steps is one large matmul (MXU-friendly);
+    the scan body only does the (B, H) @ (H, 4H) recurrent matmul.  Gate
+    nonlinearities and cell state stay float32 for stability; matmuls run in
+    ``compute_dtype``.  Gate order (i, f, g, o); forget-gate bias init 1.
+    """
+    hidden_dim: int
+    compute_dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, xs, h0, c0):
+        # xs: (B, T, F); h0, c0: (B, H)
+        B, T, F = xs.shape
+        H = self.hidden_dim
+        cd = self.compute_dtype
+
+        wi = self.param("wi", nn.initializers.xavier_uniform(), (F, 4 * H),
+                        self.param_dtype)
+        wh = self.param("wh", nn.initializers.orthogonal(), (H, 4 * H),
+                        self.param_dtype)
+
+        def bias_init(key, shape, dtype):
+            b = jnp.zeros(shape, dtype)
+            return b.at[H:2 * H].set(1.0)  # forget-gate bias
+
+        b = self.param("b", bias_init, (4 * H,), self.param_dtype)
+
+        x_proj = (xs.astype(cd) @ wi.astype(cd)).astype(jnp.float32) + b
+
+        def step(carry, x_t):
+            h, c = carry
+            gates = x_t + (h.astype(cd) @ wh.astype(cd)).astype(jnp.float32)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        if self.remat:
+            step = jax.checkpoint(step)
+
+        (h, c), hs = jax.lax.scan(step, (h0.astype(jnp.float32),
+                                         c0.astype(jnp.float32)),
+                                  x_proj.swapaxes(0, 1))
+        return hs.swapaxes(0, 1), (h, c)
+
+
+class DuelingHead(nn.Module):
+    """q = V + A - mean(A) (reference: model.py:53-63, 75-77)."""
+    hidden_dim: int
+    action_dim: int
+    compute_dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(dtype=self.compute_dtype, param_dtype=self.param_dtype)
+        adv = nn.Dense(self.action_dim, **kw)(
+            nn.relu(nn.Dense(self.hidden_dim, **kw)(x)))
+        val = nn.Dense(1, **kw)(nn.relu(nn.Dense(self.hidden_dim, **kw)(x)))
+        q = val + adv - adv.mean(axis=-1, keepdims=True)
+        return q.astype(jnp.float32)
+
+
+class R2D2Network(nn.Module):
+    """The full Q-network.  Two entry points:
+
+    - ``unroll``: (obs (B,T,*obs) uint8, last_action (B,T,A), last_reward
+      (B,T), hidden (B,2,layers,H)) → (q (B,T,A) f32, new hidden).
+    - ``act``: single-step batched inference for actors/eval.
+    """
+    action_dim: int
+    cfg: Config
+
+    def setup(self):
+        cfg = self.cfg
+        cd, pd = _dtype(cfg.compute_dtype), _dtype(cfg.param_dtype)
+        torso_cls = {"nature": NatureTorso, "impala": ImpalaTorso,
+                     "mlp": MlpTorso}[cfg.torso]
+        self.torso = torso_cls(out_dim=cfg.hidden_dim, compute_dtype=cd,
+                               param_dtype=pd)
+        self.lstm_layers_ = [
+            LSTMLayer(hidden_dim=cfg.hidden_dim, compute_dtype=cd,
+                      param_dtype=pd, remat=cfg.remat, name=f"lstm_{i}")
+            for i in range(cfg.lstm_layers)
+        ]
+        self.head = DuelingHead(hidden_dim=cfg.hidden_dim,
+                                action_dim=self.action_dim,
+                                compute_dtype=cd, param_dtype=pd)
+
+    def _lstm_stack(self, xs, hidden):
+        # xs: (B, T, F); hidden: (B, 2, layers, H)
+        new_h, new_c = [], []
+        for i, layer in enumerate(self.lstm_layers_):
+            xs, (h, c) = layer(xs, hidden[:, 0, i], hidden[:, 1, i])
+            new_h.append(h)
+            new_c.append(c)
+        new_hidden = jnp.stack([jnp.stack(new_h, 1), jnp.stack(new_c, 1)], 1)
+        return xs, new_hidden
+
+    def _features(self, obs, last_action, last_reward):
+        # obs: (B, T, *obs_shape) uint8 → latent (B, T, hidden)
+        B, T = obs.shape[:2]
+        cd = _dtype(self.cfg.compute_dtype)
+        x = obs.reshape(B * T, *obs.shape[2:]).astype(cd) / 255.0
+        latent = self.torso(x).reshape(B, T, -1)
+        return jnp.concatenate(
+            [latent.astype(jnp.float32), last_action.astype(jnp.float32),
+             last_reward[..., None].astype(jnp.float32)], axis=-1)
+
+    def unroll(self, obs, last_action, last_reward, hidden):
+        feats = self._features(obs, last_action, last_reward)
+        outs, new_hidden = self._lstm_stack(feats, hidden)
+        B, T = outs.shape[:2]
+        q = self.head(outs.reshape(B * T, -1)).reshape(B, T, -1)
+        return q, new_hidden
+
+    def act(self, obs, last_action, last_reward, hidden):
+        # obs: (B, *obs_shape) uint8 — a T=1 unroll (reference model.py:65-79)
+        q, new_hidden = self.unroll(obs[:, None], last_action[:, None],
+                                    last_reward[:, None], hidden)
+        return q[:, 0], new_hidden
+
+
+def create_network(cfg: Config, action_dim: int) -> R2D2Network:
+    return R2D2Network(action_dim=action_dim, cfg=cfg)
+
+
+def init_params(cfg: Config, net: R2D2Network, key: jax.Array):
+    B, T = 1, 2
+    obs = jnp.zeros((B, T, *cfg.obs_shape), jnp.uint8)
+    la = jnp.zeros((B, T, net.action_dim), jnp.float32)
+    lr = jnp.zeros((B, T), jnp.float32)
+    hidden = jnp.zeros((B, 2, cfg.lstm_layers, cfg.hidden_dim), jnp.float32)
+    return net.init(key, obs, la, lr, hidden, method=R2D2Network.unroll)
+
+
+def zero_hidden(cfg: Config, batch: int) -> jnp.ndarray:
+    return jnp.zeros((batch, 2, cfg.lstm_layers, cfg.hidden_dim), jnp.float32)
