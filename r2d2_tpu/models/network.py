@@ -159,9 +159,10 @@ class DuelingHead(nn.Module):
     @nn.compact
     def __call__(self, x):
         kw = dict(dtype=self.compute_dtype, param_dtype=self.param_dtype)
-        adv = nn.Dense(self.action_dim, **kw)(
-            nn.relu(nn.Dense(self.hidden_dim, **kw)(x)))
-        val = nn.Dense(1, **kw)(nn.relu(nn.Dense(self.hidden_dim, **kw)(x)))
+        adv = nn.Dense(self.action_dim, name="adv_out", **kw)(
+            nn.relu(nn.Dense(self.hidden_dim, name="adv_hidden", **kw)(x)))
+        val = nn.Dense(1, name="val_out", **kw)(
+            nn.relu(nn.Dense(self.hidden_dim, name="val_hidden", **kw)(x)))
         q = val + adv - adv.mean(axis=-1, keepdims=True)
         return q.astype(jnp.float32)
 

@@ -1,16 +1,27 @@
-"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 This is the JAX-native way to test multi-chip sharding without hardware
 (SURVEY.md §4): all tests run on CPU with 8 fake devices so pjit/Mesh code
 paths execute real collectives.
+
+Two mechanisms, both needed:
+- ``XLA_FLAGS`` must be in the environment before the CPU backend
+  initialises (it is read at backend-init time, which happens lazily at the
+  first jax op inside a test).
+- ``jax.config.update("jax_platforms", "cpu")`` rather than the
+  ``JAX_PLATFORMS`` env var: this session's interpreter is pre-warmed with
+  jax already imported and pinned to the tunneled TPU platform, so the env
+  var is read too late; the config update still works post-import.
 """
 import os
 
-# Force CPU: the session environment pins JAX_PLATFORMS=axon (the tunneled
-# TPU), but tests must run on the virtual 8-device CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -123,8 +123,8 @@ def test_lstm_matches_numpy_oracle():
 
     hd = p["head"]
     flat = outs.reshape(B * T, -1)
-    adv = head([hd["Dense_0"], hd["Dense_1"]], flat)
-    val = head([hd["Dense_2"], hd["Dense_3"]], flat)
+    adv = head([hd["adv_hidden"], hd["adv_out"]], flat)
+    val = head([hd["val_hidden"], hd["val_out"]], flat)
     q_np = (val + adv - adv.mean(-1, keepdims=True)).reshape(B, T, A)
 
     np.testing.assert_allclose(np.asarray(q), q_np, rtol=1e-4, atol=1e-4)
