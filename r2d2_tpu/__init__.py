@@ -16,5 +16,6 @@ from r2d2_tpu.config import (
     impala_deep_config,
     test_config,
 )
+from r2d2_tpu.train import train, train_sync
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

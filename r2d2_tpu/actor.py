@@ -1,0 +1,213 @@
+"""Actors: experience generation against the environment.
+
+Capability-parity with the reference actor (worker.py:500-575) and its
+``AgentState`` carrier (model.py:9-24): ε-greedy acting on the recurrent
+Q-network, LocalBuffer block assembly with bootstrap Q at truncation,
+periodic weight refresh, per-actor ε ladder (train.py:15-17).
+
+TPU-first redesign — the **lockstep vector actor**: instead of N CPU
+processes each running an unbatched torch forward (worker.py:528-529), one
+driver steps N environments in lockstep and issues a single batched
+``act`` call per step.  Batched inference amortizes device dispatch and
+keeps the MXU busy (N×512 matmuls instead of N separate 1×512), which is
+the standard TPU inference-server architecture.  Each env keeps its own
+ε, LocalBuffer, and episode lifecycle, so the learning semantics are
+unchanged from the reference fleet.
+
+The bootstrap Q at a block boundary (worker.py:550-554 runs a *second*
+forward) is obtained for free here: a boundary finish is deferred one
+iteration, and the next iteration's batched Q at the new state is used —
+one forward per env step total.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.models.network import R2D2Network
+from r2d2_tpu.replay.block import Block, LocalBuffer
+from r2d2_tpu.utils.store import ParamStore
+
+# sink(block, priorities, episode_reward_or_None) — direct buffer.add in the
+# single-process trainer, queue.put in the process fabric.
+BlockSink = Callable[[Block, np.ndarray, Optional[float]], None]
+
+
+@dataclasses.dataclass
+class AgentState:
+    """Recurrent-inference state for ONE env (reference: model.py:9-24).
+
+    Arrays are unbatched host numpy; the vector actor keeps the batched
+    (N, ...) stack of these instead.
+    """
+    obs: np.ndarray            # (*obs_shape) uint8
+    last_action: np.ndarray    # (A,) float32 one-hot
+    last_reward: float
+    hidden: np.ndarray         # (2, layers, H) float32
+
+    @classmethod
+    def initial(cls, cfg: Config, obs: np.ndarray, action_dim: int
+                ) -> "AgentState":
+        la = np.zeros(action_dim, np.float32)
+        hidden = np.zeros((2, cfg.lstm_layers, cfg.hidden_dim), np.float32)
+        return cls(obs=np.asarray(obs, np.uint8), last_action=la,
+                   last_reward=0.0, hidden=hidden)
+
+    def update(self, obs: np.ndarray, action: int, reward: float,
+               hidden: np.ndarray) -> None:
+        self.obs = np.asarray(obs, np.uint8)
+        self.last_action = np.zeros_like(self.last_action)
+        self.last_action[action] = 1.0
+        self.last_reward = float(reward)
+        self.hidden = np.asarray(hidden, np.float32)
+
+
+def make_act_fn(cfg: Config, net: R2D2Network):
+    """Jitted batched single-step inference:
+    (params, obs (B,*obs) u8, last_action (B,A) f32, last_reward (B,) f32,
+    hidden (B,2,layers,H)) → (q (B,A) f32, new hidden)."""
+
+    @jax.jit
+    def act(params, obs, last_action, last_reward, hidden):
+        return net.apply(params, obs, last_action, last_reward, hidden,
+                         method=R2D2Network.act)
+
+    return act
+
+
+class VectorActor:
+    """Steps ``num_envs`` environments in lockstep with batched inference.
+
+    ``epsilons`` gives each lane its ladder ε; lanes run independent
+    episode lifecycles (reset, block cut, episode-step cap) exactly as N
+    reference actors would (worker.py:516-561).
+    """
+
+    def __init__(self, cfg: Config, envs: Sequence[Any],
+                 epsilons: Sequence[float], act_fn, param_store: ParamStore,
+                 sink: BlockSink, rng: Optional[np.random.Generator] = None):
+        assert len(envs) == len(epsilons)
+        self.cfg = cfg
+        self.envs = list(envs)
+        self.epsilons = np.asarray(epsilons, np.float64)
+        self.act_fn = act_fn
+        self.param_store = param_store
+        self.sink = sink
+        self.rng = rng or np.random.default_rng(cfg.seed)
+
+        self.N = len(envs)
+        self.action_dim = envs[0].action_space.n
+        self.buffers = [LocalBuffer(cfg, self.action_dim) for _ in envs]
+        self.episode_steps = np.zeros(self.N, np.int64)
+        self.finish_pending = np.zeros(self.N, bool)  # deferred boundary cut
+        self.actor_steps = 0
+        self._param_version = 0
+        self._params = None
+
+        # batched AgentState
+        self.obs = np.zeros((self.N, *cfg.obs_shape), np.uint8)
+        self.last_action = np.zeros((self.N, self.action_dim), np.float32)
+        self.last_reward = np.zeros(self.N, np.float32)
+        self.hidden = np.zeros((self.N, 2, cfg.lstm_layers, cfg.hidden_dim),
+                               np.float32)
+        for i in range(self.N):
+            self._reset_lane(i)
+
+    def _reset_lane(self, i: int) -> None:
+        obs, _ = self.envs[i].reset()
+        self.obs[i] = np.asarray(obs, np.uint8)
+        self.last_action[i] = 0.0
+        self.last_reward[i] = 0.0
+        self.hidden[i] = 0.0
+        self.buffers[i].reset(self.obs[i])
+        self.episode_steps[i] = 0
+        self.finish_pending[i] = False
+
+    def _refresh_params(self) -> None:
+        version, params = self.param_store.get()
+        if params is not None and version != self._param_version:
+            self._params = params
+            self._param_version = version
+
+    def run(self, max_steps: int, stop: Optional[Callable[[], bool]] = None
+            ) -> None:
+        """Run ``max_steps`` lockstep iterations (= per-actor env steps)."""
+        cfg = self.cfg
+        self._refresh_params()
+        assert self._params is not None, "ParamStore must hold initial params"
+
+        for _ in range(max_steps):
+            if stop is not None and stop():
+                return
+            q, new_hidden = self.act_fn(self._params, self.obs,
+                                        self.last_action, self.last_reward,
+                                        self.hidden)
+            q = np.asarray(q)
+            new_hidden = np.asarray(new_hidden)
+
+            # deferred block-boundary cuts: this iteration's Q at the new
+            # state is the bootstrap value (worker.py:550-554 semantics,
+            # without the second forward)
+            for i in np.nonzero(self.finish_pending)[0]:
+                self.sink(*self.buffers[i].finish(q[i]))
+                self.finish_pending[i] = False
+
+            explore = self.rng.random(self.N) < self.epsilons
+            actions = np.where(explore,
+                               self.rng.integers(self.action_dim, size=self.N),
+                               q.argmax(axis=1)).astype(np.int64)
+
+            capped: List[int] = []
+            for i, env in enumerate(self.envs):
+                a = int(actions[i])
+                obs, reward, terminated, truncated, _ = env.step(a)
+                done = bool(terminated or truncated)
+                self.obs[i] = np.asarray(obs, np.uint8)
+                self.last_action[i] = 0.0
+                self.last_action[i, a] = 1.0
+                self.last_reward[i] = reward
+                self.hidden[i] = new_hidden[i]
+                self.episode_steps[i] += 1
+
+                self.buffers[i].add(a, float(reward), self.obs[i], q[i],
+                                    new_hidden[i])
+
+                if done:
+                    self.sink(*self.buffers[i].finish(None))
+                    self._reset_lane(i)
+                elif self.episode_steps[i] >= cfg.max_episode_steps:
+                    capped.append(i)
+                elif len(self.buffers[i]) == cfg.block_length:
+                    self.finish_pending[i] = True
+
+            if capped:
+                # episode-step cap (rare): the bootstrap must be Q at the
+                # post-step state (worker.py:550-554 runs a second forward);
+                # one extra batched forward covers all capped lanes
+                q_fresh, _ = self.act_fn(self._params, self.obs,
+                                         self.last_action, self.last_reward,
+                                         self.hidden)
+                q_fresh = np.asarray(q_fresh)
+                for i in capped:
+                    self.sink(*self.buffers[i].finish(q_fresh[i]))
+                    self._reset_lane(i)
+
+            self.actor_steps += 1
+            if self.actor_steps % cfg.actor_update_interval == 0:
+                self._refresh_params()
+
+
+class Actor(VectorActor):
+    """A single-env actor — the reference's unit of deployment
+    (worker.py:500-515), as a 1-lane vector actor.  Used by the process
+    fabric where each actor owns a thread, and by tests."""
+
+    def __init__(self, cfg: Config, env: Any, epsilon: float, act_fn,
+                 param_store: ParamStore, sink: BlockSink,
+                 rng: Optional[np.random.Generator] = None):
+        super().__init__(cfg, [env], [epsilon], act_fn, param_store, sink,
+                         rng=rng)

@@ -171,7 +171,7 @@ def test_config(**kw) -> Config:
         block_length=8, buffer_capacity=160, learning_starts=16,
         batch_size=8, hidden_dim=16, num_actors=2,
         max_episode_steps=50, training_steps=20,
-        compute_dtype="float32",
+        compute_dtype="float32", prefetch_batches=0,
     )
     base.update(kw)
     return Config(**base)

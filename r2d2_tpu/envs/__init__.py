@@ -1,0 +1,23 @@
+"""Environment layer.
+
+``create_env`` is the single factory every other component uses
+(reference: environment.py:66-74).  It returns ALE Atari when the
+``ale_py`` plugin is installed, and otherwise (or when
+``cfg.game_name == "Fake"``) a deterministic fake Atari-shaped env so the
+framework is runnable and testable without the Atari ROMs.
+"""
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.envs.atari import (
+    NoopResetEnv,
+    WarpFrame,
+    atari_available,
+    create_env,
+)
+
+__all__ = [
+    "FakeAtariEnv",
+    "NoopResetEnv",
+    "WarpFrame",
+    "atari_available",
+    "create_env",
+]

@@ -1,0 +1,132 @@
+"""ALE Atari wrappers (gymnasium API).
+
+Behavioral parity with the reference stack (environment.py:8-74): grayscale
+obs, frameskip 4, no sticky actions, minimal action set, cv2 INTER_AREA warp
+to 84×84, 1-30 random no-ops at reset, **no frame stacking** (the LSTM
+supplies memory).  Differences are deliberate and TPU-native:
+
+- NHWC uint8 observations ``(84, 84, 1)`` instead of the reference's CHW
+  ``(1, 84, 84)`` (environment.py:52) — NHWC is XLA's native conv layout.
+- gymnasium 5-tuple step API instead of the legacy gym 4-tuple
+  (environment.py:29).
+
+ALE is optional in this image; ``atari_available()`` gates it and
+``create_env`` falls back to the fake env so every code path stays
+runnable without ROMs.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+
+try:  # gymnasium is baked in; the ALE plugin may not be
+    import gymnasium
+
+    try:
+        import ale_py  # noqa: F401  (registers ALE/* envs)
+
+        _HAS_ALE = True
+    except ImportError:
+        _HAS_ALE = False
+except ImportError:  # pragma: no cover
+    gymnasium = None
+    _HAS_ALE = False
+
+
+def atari_available() -> bool:
+    return _HAS_ALE
+
+
+class NoopResetEnv:
+    """1..noop_max random no-op steps at reset (environment.py:8-35).
+
+    Action 0 is asserted to be NOOP, matching the reference's guard
+    (environment.py:17).
+    """
+
+    def __init__(self, env, noop_max: int = 30,
+                 rng: Optional[np.random.Generator] = None):
+        self.env = env
+        self.noop_max = noop_max
+        self.noop_action = 0
+        self._rng = rng or np.random.default_rng()
+        meanings = env.unwrapped.get_action_meanings()
+        assert meanings[0] == "NOOP", meanings
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    def reset(self, **kwargs):
+        obs, info = self.env.reset(**kwargs)
+        noops = int(self._rng.integers(1, self.noop_max + 1))
+        for _ in range(noops):
+            obs, _, terminated, truncated, info = self.env.step(self.noop_action)
+            if terminated or truncated:
+                obs, info = self.env.reset(**kwargs)
+        return obs, info
+
+    def step(self, action):
+        return self.env.step(action)
+
+
+class WarpFrame:
+    """cv2 INTER_AREA resize to (height, width, 1) uint8 (environment.py:39-63),
+    NHWC instead of the reference's CHW."""
+
+    def __init__(self, env, width: int = 84, height: int = 84):
+        import cv2  # local import: cv2 is present in the image but heavy
+
+        self._cv2 = cv2
+        self.env = env
+        self._width = width
+        self._height = height
+        self.observation_space = type(
+            "Box", (), {"shape": (height, width, 1), "dtype": np.uint8})()
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    def _warp(self, obs):
+        obs = self._cv2.resize(obs, (self._width, self._height),
+                               interpolation=self._cv2.INTER_AREA)
+        return obs[..., None].astype(np.uint8)
+
+    def reset(self, **kwargs):
+        obs, info = self.env.reset(**kwargs)
+        return self._warp(obs), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._warp(obs), reward, terminated, truncated, info
+
+
+def create_env(cfg: Config, noop_start: bool = True,
+               seed: Optional[int] = None):
+    """The single env factory (reference: environment.py:66-74).
+
+    ``cfg.game_name == "Fake"`` or missing ALE → :class:`FakeAtariEnv`.
+    """
+    if cfg.game_name == "Fake" or not _HAS_ALE:
+        if cfg.game_name != "Fake":
+            import warnings
+
+            warnings.warn(
+                f"ALE not installed; substituting FakeAtariEnv for "
+                f"{cfg.game_name!r}", stacklevel=2)
+        h, w = cfg.obs_shape[0], cfg.obs_shape[1]
+        return FakeAtariEnv(obs_shape=(h, w, 1), action_dim=4,
+                            seed=0 if seed is None else seed)
+
+    env = gymnasium.make(
+        f"ALE/{cfg.game_name}-v5", obs_type="grayscale",
+        frameskip=cfg.frameskip, repeat_action_probability=0.0,
+        full_action_space=False)
+    env = WarpFrame(env, width=cfg.obs_shape[1], height=cfg.obs_shape[0])
+    if noop_start:
+        env = NoopResetEnv(env, noop_max=cfg.noop_max,
+                           rng=np.random.default_rng(seed))
+    return env

@@ -1,0 +1,182 @@
+"""Learner host loop: the drivetrain around the jitted train step.
+
+Capability-parity with the reference learner's ``run`` (worker.py:300-381):
+staged batch prefetch, periodic weight publication, periodic checkpointing.
+Target-net sync is already *inside* the jitted step (in-graph select), so
+the host loop only drives data and cadences.
+
+TPU-first redesign:
+- The prefetch thread moves batches host→device (``jax.device_put`` with
+  the mesh sharding) **ahead of** the compute stream, so H2D overlaps the
+  previous step — the async analogue of the reference's host-side staging
+  list (worker.py:309-316).
+- Weight publication is a versioned immutable snapshot (ParamStore), not a
+  shared-memory mutation (worker.py:306-307).
+- Multi-device: pass a Mesh and the same loop drives the GSPMD-sharded
+  step; the loop code is identical.
+- Checkpointing saves the full TrainState with resume (checkpoint.py),
+  beating the reference's save-only ``torch.save`` (worker.py:380-381).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.checkpoint import Checkpointer
+from r2d2_tpu.config import Config
+from r2d2_tpu.learner.step import TrainState, jit_train_step
+from r2d2_tpu.models.network import R2D2Network
+from r2d2_tpu.parallel.mesh import (
+    DEVICE_BATCH_KEYS,
+    batch_sharding,
+    replicate_state,
+    sharded_train_step,
+)
+from r2d2_tpu.utils.store import ParamStore
+
+# batch_source() -> host batch dict (blocking); returns None to stop early.
+BatchSource = Callable[[], Optional[Dict[str, np.ndarray]]]
+# priority_sink(idxes, priorities, old_ptr, loss)
+PrioritySink = Callable[[np.ndarray, np.ndarray, int, float], None]
+
+
+class Learner:
+    def __init__(self, cfg: Config, net: R2D2Network, state: TrainState,
+                 mesh: Optional[Any] = None,
+                 param_store: Optional[ParamStore] = None,
+                 checkpointer: Optional[Checkpointer] = None,
+                 start_env_steps: int = 0, start_minutes: float = 0.0):
+        self.cfg = cfg
+        self.net = net
+        self.mesh = mesh
+        self.param_store = param_store
+        self.checkpointer = checkpointer
+        self.env_steps = start_env_steps
+        self.start_minutes = start_minutes
+
+        if mesh is not None:
+            self._step_fn = sharded_train_step(cfg, net, mesh)
+            self._shardings = batch_sharding(mesh)
+            self.state = replicate_state(mesh, state)
+        else:
+            self._step_fn = jit_train_step(cfg, net)
+            self._shardings = None
+            self.state = state
+
+        if self.param_store is not None:
+            self._publish()
+
+    def _publish(self) -> None:
+        # deep-copy: the jitted step donates the state, so a published
+        # snapshot must not alias state buffers or the next update would
+        # delete it out from under the actors
+        self.param_store.publish(
+            jax.tree.map(jnp.copy, self.state.params))
+
+    @property
+    def num_updates(self) -> int:
+        return int(jax.device_get(self.state.step))
+
+    def _stage(self, batch: Dict[str, np.ndarray]
+               ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Split host bookkeeping from device fields and start the H2D copy."""
+        host = {k: batch[k] for k in batch if k not in DEVICE_BATCH_KEYS}
+        if self._shardings is not None:
+            dev = {k: jax.device_put(batch[k], self._shardings[k])
+                   for k in DEVICE_BATCH_KEYS}
+        else:
+            dev = {k: jax.device_put(batch[k]) for k in DEVICE_BATCH_KEYS}
+        return dev, host
+
+    def run(self, batch_source: BatchSource,
+            priority_sink: Optional[PrioritySink] = None,
+            max_steps: Optional[int] = None,
+            stop: Optional[Callable[[], bool]] = None) -> Dict[str, float]:
+        """Drive training until ``cfg.training_steps`` (or ``max_steps`` more
+        updates, or ``stop()``).  Returns summary metrics."""
+        cfg = self.cfg
+        t0 = time.time()
+        target = cfg.training_steps if max_steps is None else (
+            self.num_updates + max_steps)
+
+        # prefetch_batches == 0 → fully synchronous staging (deterministic;
+        # used by train_sync and tests).  Otherwise a daemon thread keeps up
+        # to ``prefetch_batches`` device-resident batches ahead of compute.
+        if cfg.prefetch_batches > 0:
+            staged: "queue.Queue" = queue.Queue(maxsize=cfg.prefetch_batches)
+            done = threading.Event()
+
+            def prefetch():
+                while not done.is_set():
+                    batch = batch_source()
+                    if batch is None:
+                        staged.put(None)
+                        return
+                    staged.put(self._stage(batch))
+
+            pf = threading.Thread(target=prefetch, daemon=True,
+                                  name="prefetch")
+            pf.start()
+
+            def next_item():
+                return staged.get()
+        else:
+            done = threading.Event()
+
+            def next_item():
+                batch = batch_source()
+                return None if batch is None else self._stage(batch)
+
+        losses = []
+        try:
+            while self.num_updates < target:
+                if stop is not None and stop():
+                    break
+                item = next_item()
+                if item is None:
+                    break
+                dev_batch, host = item
+                self.state, loss, priorities = self._step_fn(self.state,
+                                                             dev_batch)
+                # one device→host sync per step: loss + priorities together
+                loss = float(jax.device_get(loss))
+                priorities = np.asarray(jax.device_get(priorities))
+                losses.append(loss)
+                self.env_steps = int(host.get("env_steps", self.env_steps))
+
+                if priority_sink is not None:
+                    priority_sink(host["idxes"], priorities,
+                                  host["block_ptr"], loss)
+
+                updates = self.num_updates
+                if (self.param_store is not None
+                        and updates % cfg.weight_publish_interval == 0):
+                    self._publish()
+                if (self.checkpointer is not None
+                        and updates % cfg.save_interval == 0):
+                    self._save(updates, t0)
+        finally:
+            done.set()
+
+        if self.checkpointer is not None:
+            self._save(self.num_updates, t0)
+        mins = self.start_minutes + (time.time() - t0) / 60.0
+        return dict(
+            num_updates=self.num_updates,
+            env_steps=self.env_steps,
+            minutes=mins,
+            mean_loss=float(np.mean(losses[-100:])) if losses else float("nan"),
+        )
+
+    def _save(self, updates: int, t0: float) -> None:
+        minutes = self.start_minutes + (time.time() - t0) / 60.0
+        self.checkpointer.save(updates, jax.device_get(self.state),
+                               meta=dict(env_steps=self.env_steps,
+                                         minutes=minutes,
+                                         game=self.cfg.game_name))

@@ -64,6 +64,9 @@ def make_optimizer(cfg: Config) -> optax.GradientTransformation:
 
 def create_train_state(cfg: Config, params) -> TrainState:
     opt = make_optimizer(cfg)
+    # copy params into the state: the jitted step donates its input state,
+    # so the state must not alias buffers the caller still holds
+    params = jax.tree.map(jnp.copy, params)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

@@ -1,0 +1,15 @@
+from r2d2_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+    sharded_train_step,
+)
+
+__all__ = [
+    "batch_sharding",
+    "make_mesh",
+    "replicated",
+    "shard_batch",
+    "sharded_train_step",
+]

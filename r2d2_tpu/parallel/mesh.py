@@ -1,0 +1,112 @@
+"""Device-mesh parallelism for the learner.
+
+The reference learner is a single device (worker.py:283-285); this module is
+the framework's first new parallelism axis (SURVEY.md §2): **learner data
+parallelism over a ``jax.sharding.Mesh``**, expressed as GSPMD shardings on
+the jitted train step rather than hand-written collectives.
+
+Design:
+- The training batch is sharded along the leading batch axis over the
+  ``"dp"`` mesh axis; params/opt state are replicated.
+- The loss is a *global* masked mean and priorities are per-sample, so the
+  same :func:`r2d2_tpu.learner.step.make_train_step` function compiles
+  unchanged under a mesh — XLA inserts the gradient ``psum`` and the
+  loss-normalisation collectives over ICI.  No NCCL/MPI translation, no
+  per-device bookkeeping in user code.
+- ``mesh_shape`` comes from config (e.g. ``(("dp", 8),)``); the default is
+  all local devices on ``dp``.  Axes other than ``"dp"`` are accepted and
+  currently used only for parameter replication-groups (a ``"mp"`` axis is
+  reserved for sharding the LSTM 4H kernel when models outgrow one chip).
+
+Multi-host: the same code runs under ``jax.distributed`` with a global
+mesh; batches then arrive per-host and shardings ride ICI within a slice
+and DCN across slices.  Nothing here assumes single-process.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.learner.step import TrainState, make_train_step
+from r2d2_tpu.models.network import R2D2Network
+
+# device-batch fields (everything else in a replay batch is host-only
+# bookkeeping: idxes, block_ptr, env_steps)
+DEVICE_BATCH_KEYS = (
+    "obs", "last_action", "last_reward", "hidden", "action",
+    "n_step_reward", "n_step_gamma", "burn_in", "learning", "forward",
+    "is_weights",
+)
+
+
+def make_mesh(cfg: Config, devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """Build the learner mesh from ``cfg.mesh_shape``.
+
+    Empty ``mesh_shape`` (the default) → all available devices on ``"dp"``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = cfg.mesh_shape or (("dp", len(devices)),)
+    names = tuple(name for name, _ in spec)
+    sizes = tuple(size for _, size in spec)
+    need = math.prod(sizes)
+    if need > len(devices):
+        raise ValueError(
+            f"mesh_shape {spec} needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need], dtype=object).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+    """Leading-axis ``dp`` sharding for every device-batch field."""
+    dp = NamedSharding(mesh, P("dp"))
+    return {k: dp for k in DEVICE_BATCH_KEYS}
+
+
+def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Host batch → device batch: strip host-only fields, place shards.
+
+    ``jax.device_put`` with a NamedSharding splits the host array across
+    the ``dp`` devices (the H2D analogue of worker.py:330-342, minus the
+    fields the TPU step never needs).
+    """
+    shardings = batch_sharding(mesh)
+    return {k: jax.device_put(batch[k], shardings[k])
+            for k in DEVICE_BATCH_KEYS}
+
+
+def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh):
+    """The jitted train step compiled over the mesh.
+
+    Same function as the single-device step; only shardings differ.  The
+    per-device batch is ``batch_size // dp``; semantics are identical to
+    the single-device step because loss/priorities are computed with
+    global reductions (verified in tests/test_parallel.py).
+    """
+    if cfg.batch_size % mesh.shape["dp"] != 0:
+        raise ValueError(
+            f"batch_size {cfg.batch_size} not divisible by dp={mesh.shape['dp']}")
+    step = make_train_step(cfg, net)
+    repl = replicated(mesh)
+    dp = NamedSharding(mesh, P("dp"))
+    # sharding pytree prefixes: one sharding per argument subtree — the
+    # whole TrainState replicated, every batch field batch-sharded
+    return jax.jit(
+        step,
+        in_shardings=(repl, {k: dp for k in DEVICE_BATCH_KEYS}),
+        out_shardings=(repl, repl, dp),
+        donate_argnums=(0,),
+    )
+
+
+def replicate_state(mesh: Mesh, state: TrainState) -> TrainState:
+    """Place a host/single-device TrainState replicated over the mesh."""
+    return jax.device_put(state, replicated(mesh))

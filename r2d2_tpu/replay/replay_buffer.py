@@ -124,6 +124,10 @@ class ReplayBuffer:
         B = batch_size or cfg.batch_size
         K, L, T = cfg.seqs_per_block, cfg.learning_steps, cfg.seq_len
         with self.lock:
+            if self.size == 0:
+                raise RuntimeError(
+                    "sample_batch on an empty buffer; wait for add() (use "
+                    "`ready` to gate on learning_starts)")
             idxes, is_weights = self.tree.sample(B)
             block_idx = idxes // K
             seq_idx = idxes % K
@@ -134,7 +138,17 @@ class ReplayBuffer:
 
             # obs-coordinate window start: first burn-in prefix + k full
             # learning windows (worker.py:186), reaching back over this
-            # sequence's own burn-in
+            # sequence's own burn-in.
+            #
+            # INVARIANT (load-bearing): the clamp below pads short sequences
+            # with whatever bytes previously occupied the ring slot.  This is
+            # safe because every index the learner gathers is
+            # < burn_in + learning + forward (learner/step.py:_window_indices
+            # clamps to that bound), i.e. strictly before the stale region,
+            # and loss/priorities are masked to the learning window.  The
+            # stale tail does flow through the LSTM scan, but only *after*
+            # the last gathered timestep, so it cannot affect any used
+            # output.  Tested in tests/test_replay_buffer.py.
             start = self.first_burn_in[block_idx] + seq_idx * L
             t0 = start - burn_in
             time_idx = np.minimum(t0[:, None] + np.arange(T), cfg.max_block_steps - 1)

@@ -1,0 +1,32 @@
+"""Versioned immutable parameter publication.
+
+Replaces the reference's shared-memory model mutation
+(``train.py:23``, ``worker.py:306-307``, pulled at ``worker.py:564-566``),
+which tolerates torn reads across tensors while the learner writes.  Here
+the learner publishes an immutable pytree snapshot under a lock and actors
+pull by version — the torn-read race is structurally impossible
+(SURVEY.md §5.2).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Tuple
+
+
+class ParamStore:
+    def __init__(self, params: Optional[Any] = None):
+        self._lock = threading.Lock()
+        self._version = 0 if params is None else 1
+        self._params = params
+
+    def publish(self, params: Any) -> int:
+        """Swap in a new snapshot; returns its version (monotonic from 1)."""
+        with self._lock:
+            self._params = params
+            self._version += 1
+            return self._version
+
+    def get(self) -> Tuple[int, Any]:
+        """Latest ``(version, params)``; params is None until first publish."""
+        with self._lock:
+            return self._version, self._params

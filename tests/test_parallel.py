@@ -1,0 +1,112 @@
+"""Multi-device learner tests on the virtual 8-device CPU mesh.
+
+The conftest forces ``--xla_force_host_platform_device_count=8``, so the
+GSPMD-sharded train step executes real collectives here (SURVEY.md §4).
+"""
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.learner.step import create_train_state, jit_train_step
+from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.parallel.mesh import (
+    make_mesh,
+    replicate_state,
+    shard_batch,
+    sharded_train_step,
+)
+
+A = 4
+
+
+def make_batch(cfg, rng):
+    B, T, L = cfg.batch_size, cfg.seq_len, cfg.learning_steps
+    return dict(
+        obs=rng.integers(0, 255, (B, T, *cfg.obs_shape), dtype=np.uint8),
+        last_action=rng.random((B, T, A)).astype(np.float32),
+        last_reward=rng.random((B, T)).astype(np.float32),
+        hidden=rng.normal(size=(B, 2, cfg.lstm_layers, cfg.hidden_dim)
+                          ).astype(np.float32),
+        action=rng.integers(0, A, (B, L)).astype(np.int32),
+        n_step_reward=rng.random((B, L)).astype(np.float32),
+        n_step_gamma=np.full((B, L), 0.99, np.float32),
+        burn_in=np.full(B, cfg.burn_in_steps, np.int32),
+        learning=np.full(B, L, np.int32),
+        forward=np.full(B, cfg.forward_steps, np.int32),
+        is_weights=np.ones(B, np.float32),
+    )
+
+
+def test_eight_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_make_mesh_default_spans_all_devices():
+    cfg = make_test_config()
+    mesh = make_mesh(cfg)
+    assert mesh.shape == {"dp": 8}
+
+
+def test_make_mesh_custom_shape_and_errors():
+    cfg = make_test_config(mesh_shape=(("dp", 4),))
+    assert make_mesh(cfg).shape == {"dp": 4}
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(make_test_config(mesh_shape=(("dp", 16),)))
+    with pytest.raises(ValueError, match="divisible"):
+        net = create_network(make_test_config(batch_size=6), A)
+        sharded_train_step(make_test_config(batch_size=6), net,
+                           make_mesh(make_test_config()))
+
+
+def test_sharded_step_matches_single_device():
+    """dp=8 GSPMD step must reproduce the single-device step: same loss,
+    priorities, and updated params (the semantics-preservation contract of
+    SURVEY.md §7: per-device batch 64/n with global reductions)."""
+    cfg = make_test_config()
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    batch = make_batch(cfg, np.random.default_rng(0))
+
+    step1 = jit_train_step(cfg, net)
+    s1, loss1, prio1 = step1(create_train_state(cfg, params),
+                             jax.tree.map(jax.numpy.asarray, batch))
+
+    mesh = make_mesh(cfg)
+    stepN = sharded_train_step(cfg, net, mesh)
+    sN, lossN, prioN = stepN(replicate_state(mesh, create_train_state(cfg, params)),
+                             shard_batch(mesh, batch))
+
+    assert float(loss1) == pytest.approx(float(lossN), rel=1e-5)
+    np.testing.assert_allclose(np.asarray(prio1), np.asarray(prioN),
+                               rtol=1e-4, atol=1e-6)
+    for p1, pN in zip(jax.tree.leaves(s1.params), jax.tree.leaves(sN.params)):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(pN),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_sharded_multistep_stays_in_sync():
+    """Run 3 sharded steps (with in-graph target sync crossing its cadence)
+    and compare against 3 single-device steps."""
+    cfg = make_test_config(target_net_update_interval=2)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(1)
+    batches = [make_batch(cfg, rng) for _ in range(3)]
+
+    step1 = jit_train_step(cfg, net)
+    s1 = create_train_state(cfg, params)
+    for b in batches:
+        s1, loss1, _ = step1(s1, jax.tree.map(jax.numpy.asarray, b))
+
+    mesh = make_mesh(cfg)
+    stepN = sharded_train_step(cfg, net, mesh)
+    sN = replicate_state(mesh, create_train_state(cfg, params))
+    for b in batches:
+        sN, lossN, _ = stepN(sN, shard_batch(mesh, b))
+
+    assert int(s1.step) == int(sN.step) == 3
+    for p1, pN in zip(jax.tree.leaves(s1.target_params),
+                      jax.tree.leaves(sN.target_params)):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(pN),
+                                   rtol=1e-4, atol=1e-6)

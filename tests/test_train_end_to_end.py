@@ -1,0 +1,157 @@
+"""End-to-end integration tests (VERDICT r1 item 1): the system trains.
+
+Uses the fake env + tiny test config so the full pipeline — actor fleet →
+LocalBuffer → ReplayBuffer → sampling → jitted learner step → priority
+feedback → weight publication → checkpointing — runs in seconds on CPU.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs import FakeAtariEnv
+from r2d2_tpu.evaluate import evaluate_params, evaluate_sweep
+from r2d2_tpu.learner.learner import Learner
+from r2d2_tpu.learner.step import create_train_state
+from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.train import train, train_sync
+
+A = 4
+
+
+def env_factory(cfg, seed):
+    return FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=seed,
+                        episode_len=32)
+
+
+def test_train_sync_learns():
+    """The CI-able smoke run: fill past learning_starts, take 150+ updates,
+    loss finite and decreasing, episode returns logged."""
+    cfg = make_test_config(game_name="Fake", training_steps=150)
+    m = train_sync(cfg, env_factory=env_factory)
+
+    assert m["num_updates"] == 150
+    losses = np.asarray(m["losses"])
+    assert losses.shape[0] == 150
+    assert np.isfinite(losses).all()
+    assert losses[-40:].mean() < losses[:40].mean(), \
+        "loss must decrease over training"
+    assert len(m["episode_returns"]) > 0
+    assert m["env_steps"] >= cfg.learning_starts
+
+
+def test_train_threaded_fabric():
+    """The concurrent fabric: all planes (actor ingest / sampling / learner /
+    priority feedback / logging) overlap and the run terminates cleanly."""
+    cfg = make_test_config(game_name="Fake", training_steps=40,
+                           prefetch_batches=2, log_interval=0.5)
+    m = train(cfg, env_factory=env_factory, max_wall_seconds=120,
+              verbose=False)
+    assert m["num_updates"] == 40
+    assert m["buffer_training_steps"] == 40  # priority feedback all applied
+    assert np.isfinite(m["mean_loss"])
+    assert len(m["logs"]) > 0  # stats loop produced entries
+
+
+def _scripted_batches(cfg, n, seed=0):
+    rng = np.random.default_rng(seed)
+    B, T, L = cfg.batch_size, cfg.seq_len, cfg.learning_steps
+    out = []
+    for _ in range(n):
+        out.append(dict(
+            obs=rng.integers(0, 255, (B, T, *cfg.obs_shape), dtype=np.uint8),
+            last_action=rng.random((B, T, A)).astype(np.float32),
+            last_reward=rng.random((B, T)).astype(np.float32),
+            hidden=rng.normal(size=(B, 2, cfg.lstm_layers, cfg.hidden_dim)
+                              ).astype(np.float32),
+            action=rng.integers(0, A, (B, L)).astype(np.int32),
+            n_step_reward=rng.random((B, L)).astype(np.float32),
+            n_step_gamma=np.full((B, L), 0.9, np.float32),
+            burn_in=np.full(B, cfg.burn_in_steps, np.int32),
+            learning=np.full(B, L, np.int32),
+            forward=np.full(B, cfg.forward_steps, np.int32),
+            is_weights=np.ones(B, np.float32),
+            idxes=np.arange(B), block_ptr=0, env_steps=1000,
+        ))
+    return out
+
+
+def test_checkpoint_resume_bit_exact(tmp_path):
+    """Kill/restart resumes bit-exact (VERDICT r1 item 6): 6 updates with a
+    checkpoint at 3, restart from the checkpoint, replay updates 4-6 → same
+    params as the uninterrupted run."""
+    from r2d2_tpu.checkpoint import Checkpointer
+
+    cfg = make_test_config(save_interval=3, training_steps=6)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    batches = _scripted_batches(cfg, 6)
+
+    # uninterrupted run
+    l_full = Learner(cfg, net, create_train_state(cfg, params))
+    it = iter(list(batches))
+    l_full.run(lambda: next(it, None))
+    assert l_full.num_updates == 6
+
+    # interrupted run: checkpointer saves at update 3 (and at the end of
+    # the partial run, which we ignore by restoring step 3 explicitly)
+    ck_dir = os.path.join(tmp_path, "ck")
+    l_a = Learner(cfg, net, create_train_state(cfg, params),
+                  checkpointer=Checkpointer(ck_dir), start_env_steps=0)
+    it_a = iter(list(batches[:3]))
+    l_a.run(lambda: next(it_a, None))
+    assert 3 in Checkpointer(ck_dir).steps()
+
+    # "restart": fresh Learner restored from step 3, replay batches 4-6
+    template = jax.device_get(create_train_state(cfg, params))
+    restored, meta = Checkpointer(ck_dir).restore(template, step=3)
+    assert meta["env_steps"] == 1000
+    l_b = Learner(cfg, net, restored)
+    assert l_b.num_updates == 3
+    it_b = iter(list(batches[3:]))
+    l_b.run(lambda: next(it_b, None))
+    assert l_b.num_updates == 6
+
+    for p_full, p_res in zip(jax.tree.leaves(jax.device_get(l_full.state)),
+                             jax.tree.leaves(jax.device_get(l_b.state))):
+        np.testing.assert_array_equal(np.asarray(p_full), np.asarray(p_res))
+
+
+def test_evaluate_sweep_produces_curve(tmp_path):
+    """Checkpoint sweep → learning-curve records (reference test.py:14-58)."""
+    ck_dir = os.path.join(tmp_path, "ck")
+    cfg = make_test_config(game_name="Fake", training_steps=20,
+                           save_interval=10)
+    train_sync(cfg, env_factory=env_factory, checkpoint_dir=ck_dir)
+
+    out_json = os.path.join(tmp_path, "curve.json")
+    curve = evaluate_sweep(cfg, ck_dir, env_factory, episodes=3,
+                           out_json=out_json, action_dim=A)
+    assert len(curve) >= 2
+    steps = [c["step"] for c in curve]
+    assert steps == sorted(steps)
+    for c in curve:
+        assert np.isfinite(c["mean_reward"])
+        assert c["env_frames"] >= 0
+    assert os.path.exists(out_json)
+
+
+def test_trained_policy_beats_random():
+    """After training, the greedy policy must beat a random policy on the
+    fake env (quality regression gate, not just loss plumbing)."""
+    cfg = make_test_config(game_name="Fake", training_steps=300)
+    m = train_sync(cfg, env_factory=env_factory)
+
+    net = create_network(cfg, A)
+    # random-policy baseline: epsilon=1 with fresh params
+    params0 = init_params(cfg, net, jax.random.PRNGKey(3))
+    rand_score = evaluate_params(cfg, net, params0, env_factory, episodes=5,
+                                 epsilon=1.0, seed=11)
+    # trained policy at eval epsilon
+    trained = m.get("final_params")
+    assert trained is not None
+    score = evaluate_params(cfg, net, trained, env_factory, episodes=5,
+                            epsilon=cfg.test_epsilon, seed=11)
+    assert score > rand_score, (score, rand_score)
