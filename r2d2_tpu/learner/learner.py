@@ -113,19 +113,43 @@ class Learner:
             done = threading.Event()
 
             def prefetch():
-                while not done.is_set():
-                    batch = batch_source()
-                    if batch is None:
-                        staged.put(None)
-                        return
-                    staged.put(self._stage(batch))
+                try:
+                    while not done.is_set():
+                        batch = batch_source()
+                        item = None if batch is None else self._stage(batch)
+                        # bounded put that re-checks done: when the learner
+                        # stops consuming with the queue full, the thread
+                        # must exit rather than park in put() forever (and
+                        # pin device-resident staged batches)
+                        while not done.is_set():
+                            try:
+                                staged.put(item, timeout=0.1)
+                                break
+                            except queue.Full:
+                                continue
+                        if batch is None:
+                            return
+                finally:
+                    # exception-safe end-of-stream sentinel so the consumer
+                    # can never block on a dead producer
+                    try:
+                        staged.put_nowait(None)
+                    except queue.Full:
+                        pass
 
             pf = threading.Thread(target=prefetch, daemon=True,
                                   name="prefetch")
             pf.start()
 
             def next_item():
-                return staged.get()
+                # timeout + liveness check: a producer that died with the
+                # queue full could not even enqueue its sentinel
+                while True:
+                    try:
+                        return staged.get(timeout=0.5)
+                    except queue.Empty:
+                        if not pf.is_alive():
+                            return None
         else:
             done = threading.Event()
 

@@ -65,7 +65,8 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
 
     checkpointer = Checkpointer(checkpoint_dir) if checkpoint_dir else None
     start_env_steps, start_minutes = 0, 0.0
-    if checkpointer is not None and resume and checkpointer.latest_step():
+    if (checkpointer is not None and resume
+            and checkpointer.latest_step() is not None):
         state, meta = checkpointer.restore(jax.device_get(state))
         start_env_steps = int(meta.get("env_steps", 0))
         start_minutes = float(meta.get("minutes", 0.0))
@@ -104,6 +105,9 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     Returns metrics incl. the per-update loss curve and episode returns.
     """
+    # prefetch would run batch_source (which steps the actor) on a thread,
+    # breaking the deterministic interleaving this function promises
+    cfg = cfg.replace(prefetch_batches=0)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     actor: VectorActor = sys["actor"]
     buffer: ReplayBuffer = sys["buffer"]
