@@ -21,24 +21,7 @@ import time
 import numpy as np
 
 
-def make_batch(cfg, action_dim: int, rng):
-    B, T, L = cfg.batch_size, cfg.seq_len, cfg.learning_steps
-    return dict(
-        obs=rng.integers(0, 256, (B, T, *cfg.obs_shape), dtype=np.uint8),
-        last_action=np.eye(action_dim, dtype=np.float32)[
-            rng.integers(0, action_dim, (B, T))],
-        last_reward=rng.standard_normal((B, T)).astype(np.float32),
-        hidden=(0.1 * rng.standard_normal(
-            (B, 2, cfg.lstm_layers, cfg.hidden_dim))).astype(np.float32),
-        action=rng.integers(0, action_dim, (B, L)).astype(np.int32),
-        n_step_reward=rng.standard_normal((B, L)).astype(np.float32),
-        n_step_gamma=np.full((B, L), cfg.gamma ** cfg.forward_steps,
-                             np.float32),
-        burn_in=np.full((B,), cfg.burn_in_steps, np.int32),
-        learning=np.full((B,), L, np.int32),
-        forward=np.full((B,), cfg.forward_steps, np.int32),
-        is_weights=np.ones((B,), np.float32),
-    )
+from r2d2_tpu.utils.batch import synthetic_batch as make_batch  # noqa: E402
 
 
 def main(steps: int = 100, warmup: int = 5) -> None:
@@ -60,12 +43,12 @@ def main(steps: int = 100, warmup: int = 5) -> None:
                                                          rng).items()}
 
     # synchronize via an actual host transfer: on the tunneled axon TPU
-    # platform block_until_ready does not reliably block, so fetching the
-    # final loss (which data-depends on every chained step through the
-    # donated state) is the trustworthy fence
+    # platform block_until_ready does not reliably block, so fetching a
+    # scalar that data-depends on every chained step through the donated
+    # state is the trustworthy fence
     for _ in range(warmup):
         state, loss, priorities = step_fn(state, batch)
-    float(jax.device_get(loss))
+    int(jax.device_get(state.step))
 
     t0 = time.perf_counter()
     for _ in range(steps):

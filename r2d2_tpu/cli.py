@@ -1,0 +1,165 @@
+"""Command-line entry points.
+
+The reference is driven by ``python3 train.py`` and ``python3 test.py``
+(README.md:10,14) with configuration done by editing ``config.py``.  Here the
+same two workflows are flags on one CLI:
+
+    python -m r2d2_tpu train --game MsPacman --actors 8 --ckpt-dir models/
+    python -m r2d2_tpu eval  --game MsPacman --ckpt-dir models/ --plot curve.jpg
+
+plus preset selection (``--preset pong`` etc., mirroring BASELINE.json
+configs) and typed overrides for any Config field via ``--set field=value``.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from r2d2_tpu import config as config_mod
+from r2d2_tpu.config import Config
+
+_PRESETS = {
+    "default": Config,
+    "smoke": config_mod.smoke_config,
+    "pong": config_mod.pong_config,
+    "hard_exploration": config_mod.hard_exploration_config,
+    "atari57": config_mod.atari57_config,
+    "impala_deep": config_mod.impala_deep_config,
+    "test": config_mod.test_config,
+}
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(Config)}
+
+
+def _parse_override(kv: str) -> tuple:
+    """``field=value`` → (field, typed value). Tuples/etc. parse as JSON."""
+    if "=" not in kv:
+        raise argparse.ArgumentTypeError(f"--set expects field=value, got {kv!r}")
+    name, raw = kv.split("=", 1)
+    if name not in _FIELD_TYPES:
+        raise argparse.ArgumentTypeError(f"unknown Config field {name!r}")
+    current = getattr(Config(), name)
+    if isinstance(current, bool):
+        return name, raw.lower() in ("1", "true", "yes")
+    if isinstance(current, int):
+        return name, int(raw)
+    if isinstance(current, float):
+        return name, float(raw)
+    if isinstance(current, str):
+        return name, raw
+    return name, tuple(tuple(x) if isinstance(x, list) else x
+                       for x in json.loads(raw))
+
+
+def build_config(args: argparse.Namespace) -> Config:
+    preset = _PRESETS[args.preset]
+    kw: Dict[str, Any] = {}
+    if args.game:
+        kw["game_name"] = args.game
+    if args.actors is not None:
+        kw["num_actors"] = args.actors
+    if args.training_steps is not None:
+        kw["training_steps"] = args.training_steps
+    if args.seed is not None:
+        kw["seed"] = args.seed
+    for name, value in (args.overrides or []):
+        kw[name] = value
+    if args.preset in ("atari57", "hard_exploration"):
+        game = kw.pop("game_name", None)
+        if game is None and args.preset == "atari57":
+            raise ValueError("preset 'atari57' requires --game")
+        return preset(game, **kw) if game else preset(**kw)
+    return preset(**kw)
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", choices=sorted(_PRESETS), default="default")
+    p.add_argument("--game", default=None, help="ALE game name, or 'Fake'")
+    p.add_argument("--actors", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--training-steps", type=int, default=None)
+    p.add_argument("--set", dest="overrides", action="append",
+                   type=_parse_override, metavar="FIELD=VALUE",
+                   help="override any Config field (repeatable)")
+    p.add_argument("--ckpt-dir", default=None)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="r2d2_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    pt = sub.add_parser("train", help="run distributed training")
+    _add_common(pt)
+    pt.add_argument("--resume", action="store_true",
+                    help="resume from the latest checkpoint in --ckpt-dir")
+    pt.add_argument("--mesh", action="store_true",
+                    help="data-parallel learner over all visible devices")
+    pt.add_argument("--sync", action="store_true",
+                    help="deterministic single-thread trainer (debug)")
+    pt.add_argument("--max-wall-seconds", type=float, default=None)
+    pt.add_argument("--quiet", action="store_true")
+
+    pe = sub.add_parser("eval", help="checkpoint sweep -> learning curve")
+    _add_common(pe)
+    pe.add_argument("--episodes", type=int, default=None)
+    pe.add_argument("--out-json", default=None)
+    pe.add_argument("--plot", default=None, help="write curve image here")
+
+    pb = sub.add_parser("bench", help="single-chip learner throughput")
+    pb.add_argument("--steps", type=int, default=100)
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "bench":
+        import bench
+
+        bench.main(steps=args.steps)
+        return 0
+
+    try:
+        cfg = build_config(args)
+    except ValueError as e:
+        parser.error(str(e))
+
+    if args.cmd == "train":
+        from r2d2_tpu.train import train, train_sync
+
+        if args.sync and args.max_wall_seconds is not None:
+            parser.error("--max-wall-seconds is not supported with --sync "
+                         "(the deterministic trainer runs to training_steps)")
+        fn = train_sync if args.sync else train
+        kwargs: Dict[str, Any] = dict(
+            checkpoint_dir=args.ckpt_dir, resume=args.resume,
+            use_mesh=args.mesh)
+        if not args.sync:
+            kwargs.update(max_wall_seconds=args.max_wall_seconds,
+                          verbose=not args.quiet)
+        metrics = fn(cfg, **kwargs)
+        print(json.dumps({k: v for k, v in metrics.items()
+                          if isinstance(v, (int, float, str))}))
+        return 0
+
+    if args.cmd == "eval":
+        if not args.ckpt_dir:
+            parser.error("eval requires --ckpt-dir")
+        from r2d2_tpu.envs import create_env
+        from r2d2_tpu.evaluate import evaluate_sweep
+
+        curve = evaluate_sweep(
+            cfg, args.ckpt_dir,
+            env_factory=lambda c, seed: create_env(c, noop_start=False,
+                                                   seed=seed),
+            episodes=args.episodes, out_json=args.out_json,
+            out_plot=args.plot)
+        for rec in curve:
+            print(json.dumps(rec))
+        return 0
+
+    return 1  # pragma: no cover
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""CLI: config building, overrides, and the train/eval round trip."""
+import json
+import os
+
+import pytest
+
+from r2d2_tpu.cli import _parse_override, build_config, main
+
+
+class _Args:
+    def __init__(self, **kw):
+        self.preset = kw.pop("preset", "default")
+        self.game = kw.pop("game", None)
+        self.actors = kw.pop("actors", None)
+        self.seed = kw.pop("seed", None)
+        self.training_steps = kw.pop("training_steps", None)
+        self.overrides = kw.pop("overrides", None)
+        assert not kw
+
+
+def test_parse_override_types():
+    assert _parse_override("lr=0.001") == ("lr", 0.001)
+    assert _parse_override("batch_size=32") == ("batch_size", 32)
+    assert _parse_override("torso=impala") == ("torso", "impala")
+    assert _parse_override("remat=true") == ("remat", True)
+    assert _parse_override("mesh_shape=[[\"dp\", 4]]") == (
+        "mesh_shape", (("dp", 4),))
+
+
+def test_parse_override_rejects_unknown():
+    import argparse
+
+    with pytest.raises(argparse.ArgumentTypeError):
+        _parse_override("not_a_field=3")
+    with pytest.raises(argparse.ArgumentTypeError):
+        _parse_override("no_equals_sign")
+
+
+def test_build_config_presets_and_overrides():
+    cfg = build_config(_Args(preset="pong", actors=4,
+                             overrides=[("lr", 5e-5)]))
+    assert cfg.game_name == "Pong" and cfg.num_actors == 4 and cfg.lr == 5e-5
+    cfg = build_config(_Args(preset="atari57", game="Breakout"))
+    assert cfg.game_name == "Breakout" and cfg.num_actors == 256
+    cfg = build_config(_Args(preset="impala_deep"))
+    assert cfg.torso == "impala" and cfg.lstm_layers == 2
+
+
+def test_cli_train_then_eval_round_trip(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    main(["train", "--preset", "test", "--game", "Fake", "--sync",
+          "--training-steps", "2", "--ckpt-dir", ckpt])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    metrics = json.loads(out)
+    assert metrics["num_updates"] == 2
+
+    out_json = str(tmp_path / "curve.json")
+    main(["eval", "--preset", "test", "--game", "Fake", "--ckpt-dir", ckpt,
+          "--episodes", "2", "--out-json", out_json])
+    curve = json.load(open(out_json))
+    assert curve and {"step", "env_frames", "minutes", "mean_reward"} <= set(
+        curve[-1])
+    assert curve[-1]["step"] == 2
