@@ -1,0 +1,75 @@
+"""Single-chip learner throughput benchmark.
+
+Measures the jitted R2D2 train step on the flagship config (Nature torso,
+LSTM-512, batch 64, T=85 — reference scale knobs, config.py:7,27-33) on the
+default JAX platform (the real TPU chip when run by the driver).
+
+Prints ONE JSON line:
+  {"metric": "learner_env_frames_per_sec", "value": N, "unit": "frames/s",
+   "vs_baseline": N / 50000}
+
+learner env-frames/s = batch * learning_steps * steps/s — the rate at which
+the learner consumes environment frames, measured against the BASELINE.md
+north star of >= 50,000 frames/s/chip.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+from r2d2_tpu.utils.batch import synthetic_batch as make_batch
+
+
+def main(steps: int = 100, warmup: int = 5) -> None:
+    import jax
+
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.learner.step import create_train_state, jit_train_step
+    from r2d2_tpu.models.network import create_network, init_params
+
+    cfg = Config()
+    action_dim = 9  # MsPacman minimal action set
+    net = create_network(cfg, action_dim)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    state = create_train_state(cfg, params)
+    step_fn = jit_train_step(cfg, net)
+
+    rng = np.random.default_rng(0)
+    batch = {k: jax.device_put(v) for k, v in make_batch(cfg, action_dim,
+                                                         rng).items()}
+
+    # synchronize via an actual host transfer: on the tunneled axon TPU
+    # platform block_until_ready does not reliably block, so the fence is a
+    # fetch of the last warmup loss — a scalar that data-depends on the full
+    # forward/backward of every chained step through the donated state
+    for _ in range(warmup):
+        state, loss, priorities = step_fn(state, batch)
+    if warmup:
+        float(jax.device_get(loss))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss, priorities = step_fn(state, batch)
+    final_loss = float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+
+    steps_per_sec = steps / dt
+    frames_per_sec = cfg.batch_size * cfg.learning_steps * steps_per_sec
+    baseline = 50_000.0
+    print(json.dumps({
+        "metric": "learner_env_frames_per_sec",
+        "value": round(frames_per_sec, 1),
+        "unit": "frames/s",
+        "vs_baseline": round(frames_per_sec / baseline, 3),
+    }))
+    print(f"# platform={jax.devices()[0].platform} "
+          f"steps/s={steps_per_sec:.2f} dt={dt:.2f}s steps={steps}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main(steps=int(sys.argv[1]) if len(sys.argv) > 1 else 100)

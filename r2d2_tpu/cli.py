@@ -43,7 +43,13 @@ def _parse_override(kv: str) -> tuple:
         raise argparse.ArgumentTypeError(f"unknown Config field {name!r}")
     current = getattr(Config(), name)
     if isinstance(current, bool):
-        return name, raw.lower() in ("1", "true", "yes")
+        low = raw.lower()
+        if low in ("1", "true", "yes"):
+            return name, True
+        if low in ("0", "false", "no"):
+            return name, False
+        raise argparse.ArgumentTypeError(
+            f"{name} expects a boolean (true/false), got {raw!r}")
     if isinstance(current, int):
         return name, int(raw)
     if isinstance(current, float):
@@ -114,7 +120,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     if args.cmd == "bench":
-        import bench
+        from r2d2_tpu import bench
 
         bench.main(steps=args.steps)
         return 0

@@ -61,7 +61,8 @@ class Learner:
         self.start_minutes = start_minutes
 
         if mesh is not None:
-            self._step_fn = sharded_train_step(cfg, net, mesh)
+            self._step_fn = sharded_train_step(cfg, net, mesh,
+                                               state_template=state)
             self._shardings = batch_sharding(mesh)
             self.state = replicate_state(mesh, state)
         else:

@@ -65,6 +65,53 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# ---------------------------------------------------------------------------
+# model parallelism: parameter sharding rules over the "mp" axis
+# ---------------------------------------------------------------------------
+
+def _param_spec(path: Tuple[Any, ...], leaf, mp: int) -> P:
+    """PartitionSpec for one parameter (or optimizer-moment) leaf.
+
+    The rule shards every large matmul kernel on its OUTPUT dimension over
+    ``mp`` — the classic Megatron column split, expressed as a GSPMD
+    annotation (XLA inserts the all-gathers/reduce-scatters):
+
+    - LSTM ``wi`` (F, 4H) and ``wh`` (H, 4H): last dim over mp.  The gate
+      nonlinearities are elementwise in the 4H dim, so the split is clean.
+    - Dense ``kernel`` (F, O): last dim over mp (torso FC and head hiddens
+      dominate; tiny output heads fall back to replication via the
+      divisibility guard).
+    - Conv kernels, biases, scalars: replicated.  Conv compute is batch-
+      dominated and already split by dp; biases are small.
+
+    Anything whose dim is not divisible by ``mp`` is replicated — semantics
+    are identical either way, this is purely a layout choice.
+    """
+    names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+    shape = getattr(leaf, "shape", ())
+    if len(shape) == 2 and shape[-1] % mp == 0 and (
+            "wi" in names or "wh" in names or "kernel" in names):
+        return P(None, "mp")
+    return P()
+
+
+def state_shardings(mesh: Mesh, state) -> Any:
+    """A TrainState-shaped tree of NamedShardings under the param rule.
+
+    Works for ``params``, ``target_params``, and the optimizer moments
+    without special-casing optax internals: adam's ``mu``/``nu`` subtrees
+    carry the same trailing key paths as the params they mirror, so the
+    path-based rule lands on them identically (moments must share their
+    param's layout or every update would reshard).
+    """
+    if "mp" not in mesh.axis_names:
+        return jax.tree.map(lambda _: replicated(mesh), state)
+    mp = mesh.shape["mp"]
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _param_spec(path, leaf, mp)),
+        state)
+
+
 def batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
     """Leading-axis ``dp`` sharding for every device-batch field."""
     dp = NamedSharding(mesh, P("dp"))
@@ -83,30 +130,43 @@ def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
             for k in DEVICE_BATCH_KEYS}
 
 
-def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh):
+def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
+                       state_template: Optional[TrainState] = None):
     """The jitted train step compiled over the mesh.
 
     Same function as the single-device step; only shardings differ.  The
-    per-device batch is ``batch_size // dp``; semantics are identical to
-    the single-device step because loss/priorities are computed with
-    global reductions (verified in tests/test_parallel.py).
+    per-device batch is ``batch_size // dp``; with an ``mp`` axis the big
+    kernels (and their optimizer moments) additionally shard over mp per
+    :func:`_param_spec`.  Semantics are identical to the single-device
+    step because loss/priorities are computed with global reductions
+    (verified in tests/test_parallel.py).
+
+    ``state_template`` (shapes only — ``jax.eval_shape`` output is fine)
+    is required when the mesh has an ``mp`` axis so per-leaf shardings can
+    be derived; a dp-only mesh replicates the whole state.
     """
     if cfg.batch_size % mesh.shape["dp"] != 0:
         raise ValueError(
             f"batch_size {cfg.batch_size} not divisible by dp={mesh.shape['dp']}")
+    if "mp" in mesh.axis_names and state_template is None:
+        raise ValueError("an mp mesh needs state_template to derive "
+                         "per-parameter shardings")
     step = make_train_step(cfg, net)
     repl = replicated(mesh)
     dp = NamedSharding(mesh, P("dp"))
-    # sharding pytree prefixes: one sharding per argument subtree — the
-    # whole TrainState replicated, every batch field batch-sharded
+    st_shard = (state_shardings(mesh, state_template)
+                if state_template is not None
+                else repl)
     return jax.jit(
         step,
-        in_shardings=(repl, {k: dp for k in DEVICE_BATCH_KEYS}),
-        out_shardings=(repl, repl, dp),
+        in_shardings=(st_shard, {k: dp for k in DEVICE_BATCH_KEYS}),
+        out_shardings=(st_shard, repl, dp),
         donate_argnums=(0,),
     )
 
 
 def replicate_state(mesh: Mesh, state: TrainState) -> TrainState:
-    """Place a host/single-device TrainState replicated over the mesh."""
-    return jax.device_put(state, replicated(mesh))
+    """Place a host/single-device TrainState onto the mesh with the layout
+    :func:`sharded_train_step` expects (replicated on dp-only meshes,
+    kernel-sharded when the mesh has an mp axis)."""
+    return jax.device_put(state, state_shardings(mesh, state))
