@@ -68,6 +68,8 @@ class Config:
     compute_dtype: str = "bfloat16"   # activations dtype for conv/matmul
     param_dtype: str = "float32"
     remat: bool = False               # rematerialise the LSTM scan (long seq)
+    lstm_impl: str = "auto"           # "auto" | "scan" | "pallas" (ops/lstm.py)
+    pallas_interpret: bool = False    # run pallas kernels interpreted (CPU tests)
     mesh_shape: Tuple[Tuple[str, int], ...] = ()  # e.g. (("dp", 4), ("mp", 2))
     prefetch_batches: int = 4         # reference staging list depth, worker.py:312
     seed: int = 0
@@ -114,6 +116,13 @@ class Config:
             raise ValueError(f"unknown torso {self.torso!r}")
         if self.lstm_layers < 1:
             raise ValueError("lstm_layers must be >= 1")
+        if self.lstm_impl not in ("auto", "scan", "pallas"):
+            raise ValueError(f"unknown lstm_impl {self.lstm_impl!r}")
+        if self.lstm_impl == "pallas" and self.remat:
+            raise ValueError(
+                "lstm_impl='pallas' cannot honour remat=True (the fused "
+                "kernel always materialises its residuals); use "
+                "lstm_impl='auto' or 'scan' for rematerialised long unrolls")
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)

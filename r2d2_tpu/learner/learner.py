@@ -98,10 +98,17 @@ class Learner:
     def run(self, batch_source: BatchSource,
             priority_sink: Optional[PrioritySink] = None,
             max_steps: Optional[int] = None,
-            stop: Optional[Callable[[], bool]] = None) -> Dict[str, float]:
+            stop: Optional[Callable[[], bool]] = None,
+            tracer: Optional[Any] = None) -> Dict[str, float]:
         """Drive training until ``cfg.training_steps`` (or ``max_steps`` more
-        updates, or ``stop()``).  Returns summary metrics."""
+        updates, or ``stop()``).  Returns summary metrics.
+
+        ``tracer`` (utils/trace.Tracer) records per-stage spans: batch wait,
+        jitted step dispatch, and the device→host result sync."""
         cfg = self.cfg
+        if tracer is None:
+            from r2d2_tpu.utils.trace import Tracer
+            tracer = Tracer()
         t0 = time.time()
         target = cfg.training_steps if max_steps is None else (
             self.num_updates + max_steps)
@@ -163,15 +170,18 @@ class Learner:
             while self.num_updates < target:
                 if stop is not None and stop():
                     break
-                item = next_item()
+                with tracer.span("learner.batch_wait"):
+                    item = next_item()
                 if item is None:
                     break
                 dev_batch, host = item
-                self.state, loss, priorities = self._step_fn(self.state,
-                                                             dev_batch)
+                with tracer.span("learner.step_dispatch"):
+                    self.state, loss, priorities = self._step_fn(self.state,
+                                                                 dev_batch)
                 # one device→host sync per step: loss + priorities together
-                loss = float(jax.device_get(loss))
-                priorities = np.asarray(jax.device_get(priorities))
+                with tracer.span("learner.result_sync"):
+                    loss = float(jax.device_get(loss))
+                    priorities = np.asarray(jax.device_get(priorities))
                 losses.append(loss)
                 self.env_steps = int(host.get("env_steps", self.env_steps))
 

@@ -100,17 +100,27 @@ class MlpTorso(nn.Module):
 
 
 class LSTMLayer(nn.Module):
-    """Fused LSTM layer scanned over time.
+    """Fused LSTM layer unrolled over time.
 
     The input projection for all T steps is one large matmul (MXU-friendly);
-    the scan body only does the (B, H) @ (H, 4H) recurrent matmul.  Gate
+    only the (B, H) @ (H, 4H) recurrent matmul is sequential.  Gate
     nonlinearities and cell state stay float32 for stability; matmuls run in
     ``compute_dtype``.  Gate order (i, f, g, o); forget-gate bias init 1.
+
+    Two recurrence implementations behind the same parameters:
+    - ``impl="scan"``: ``jax.lax.scan`` — portable, works on CPU and under
+      GSPMD meshes.
+    - ``impl="pallas"``: the fused Pallas kernel (ops/lstm.py) — the whole
+      unroll is one TPU program with the recurrent weights and h/c held in
+      VMEM across steps, removing the per-step kernel overhead and HBM
+      re-reads of the scan (~4x faster on v5e at flagship shapes).
     """
     hidden_dim: int
     compute_dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False
+    impl: str = "scan"
+    interpret: bool = False
 
     @nn.compact
     def __call__(self, xs, h0, c0):
@@ -131,6 +141,15 @@ class LSTMLayer(nn.Module):
         b = self.param("b", bias_init, (4 * H,), self.param_dtype)
 
         x_proj = (xs.astype(cd) @ wi.astype(cd)).astype(jnp.float32) + b
+
+        if self.impl == "pallas":
+            from r2d2_tpu.ops.lstm import lstm_unroll_pallas
+
+            hs_tm, h, c = lstm_unroll_pallas(
+                x_proj.swapaxes(0, 1), wh,
+                h0.astype(jnp.float32), c0.astype(jnp.float32),
+                compute_dtype=cd, interpret=self.interpret)
+            return hs_tm.swapaxes(0, 1), (h, c)
 
         def step(carry, x_t):
             h, c = carry
@@ -184,9 +203,11 @@ class R2D2Network(nn.Module):
                      "mlp": MlpTorso}[cfg.torso]
         self.torso = torso_cls(out_dim=cfg.hidden_dim, compute_dtype=cd,
                                param_dtype=pd)
+        impl = resolve_lstm_impl(cfg)
         self.lstm_layers_ = [
             LSTMLayer(hidden_dim=cfg.hidden_dim, compute_dtype=cd,
-                      param_dtype=pd, remat=cfg.remat, name=f"lstm_{i}")
+                      param_dtype=pd, remat=cfg.remat, impl=impl,
+                      interpret=cfg.pallas_interpret, name=f"lstm_{i}")
             for i in range(cfg.lstm_layers)
         ]
         self.head = DuelingHead(hidden_dim=cfg.hidden_dim,
@@ -225,6 +246,26 @@ class R2D2Network(nn.Module):
         q, new_hidden = self.unroll(obs[:, None], last_action[:, None],
                                     last_reward[:, None], hidden)
         return q[:, 0], new_hidden
+
+
+def resolve_lstm_impl(cfg: Config) -> str:
+    """``auto`` → the fused Pallas kernel on TPU, ``scan`` elsewhere.
+
+    ``auto`` also keeps the scan when ``cfg.remat`` is set: remat trades
+    FLOPs for memory by not materialising the scan carries, while the
+    Pallas kernel always streams its full residuals (hs/cs/gates) to HBM —
+    for long-unroll configs that need remat to fit, the scan is the right
+    engine.
+
+    Both implementations declare identical parameters, so checkpoints and
+    param pytrees are interchangeable between them (e.g. train with pallas
+    on TPU, evaluate with scan on CPU).
+    """
+    if cfg.lstm_impl != "auto":
+        return cfg.lstm_impl
+    if cfg.remat:
+        return "scan"
+    return "pallas" if jax.default_backend() == "tpu" else "scan"
 
 
 def create_network(cfg: Config, action_dim: int) -> R2D2Network:

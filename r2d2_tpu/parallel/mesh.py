@@ -151,6 +151,17 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
     if "mp" in mesh.axis_names and state_template is None:
         raise ValueError("an mp mesh needs state_template to derive "
                          "per-parameter shardings")
+    from r2d2_tpu.models.network import create_network, resolve_lstm_impl
+    if resolve_lstm_impl(cfg) == "pallas":
+        # the fused Pallas LSTM is a single-device program GSPMD cannot
+        # partition; an explicit request is an error, while "auto" falls
+        # back to the scan recurrence (identical params) which compiles to
+        # per-shard XLA under the mesh
+        if cfg.lstm_impl == "pallas":
+            raise ValueError(
+                "lstm_impl='pallas' cannot run under a mesh (GSPMD cannot "
+                "partition the fused kernel); use lstm_impl='auto' or 'scan'")
+        net = create_network(cfg.replace(lstm_impl="scan"), net.action_dim)
     step = make_train_step(cfg, net)
     repl = replicated(mesh)
     dp = NamedSharding(mesh, P("dp"))

@@ -46,6 +46,8 @@ from r2d2_tpu.parallel.mesh import make_mesh
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
 from r2d2_tpu.utils.math import epsilon_ladder
 from r2d2_tpu.utils.store import ParamStore
+from r2d2_tpu.utils.supervisor import Supervisor
+from r2d2_tpu.utils.trace import Tracer, device_profile
 
 EnvFactory = Callable[[Config, int], Any]
 
@@ -145,8 +147,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
           checkpoint_dir: Optional[str] = None, resume: bool = False,
           use_mesh: bool = False, max_wall_seconds: Optional[float] = None,
           verbose: bool = True,
-          log_sink: Optional[Callable[[Dict[str, Any]], None]] = None
-          ) -> Dict[str, Any]:
+          log_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+          tracer: Optional[Tracer] = None,
+          profile_dir: Optional[str] = None,
+          max_thread_restarts: int = 3) -> Dict[str, Any]:
     """The full concurrent system (reference train.py:20-44 equivalent).
 
     Threads and their reference analogues:
@@ -161,35 +165,48 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     Block ingest (add_data, worker.py:124-129) needs no thread: the actor
     sink calls ``buffer.add`` directly — same-process, lock-protected.
+
+    Beyond the reference: fabric threads run under a Supervisor (crashes
+    recorded and restarted up to ``max_thread_restarts``; an exhausted
+    budget stops the run instead of hanging — SURVEY §5.3), a Tracer
+    records per-stage timings and queue-depth gauges (SURVEY §5.1), and
+    ``profile_dir`` captures a ``jax.profiler`` device trace of the run.
     """
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     actor: VectorActor = sys["actor"]
     buffer: ReplayBuffer = sys["buffer"]
     learner: Learner = sys["learner"]
+    tracer = tracer or Tracer()
+    supervisor = Supervisor(max_restarts=max_thread_restarts)
 
     stop_event = threading.Event()
     deadline = (time.time() + max_wall_seconds) if max_wall_seconds else None
 
     def stop() -> bool:
-        return stop_event.is_set() or (deadline is not None
-                                       and time.time() > deadline)
+        return (stop_event.is_set() or supervisor.any_failed
+                or (deadline is not None and time.time() > deadline))
 
     batch_queue: "queue.Queue" = queue.Queue(maxsize=8)
     priority_queue: "queue.Queue" = queue.Queue(maxsize=8)
 
     def actor_loop():
         while not stop():
-            actor.run(max_steps=256, stop=stop)
+            with tracer.span("actor.run256"):
+                actor.run(max_steps=256, stop=stop)
 
     def sample_loop():
         while not stop():
             if not buffer.ready:
                 time.sleep(0.05)
                 continue
-            try:
-                batch_queue.put(buffer.sample_batch(), timeout=0.1)
-            except queue.Full:
-                pass
+            with tracer.span("buffer.sample_batch"):
+                batch = buffer.sample_batch()
+            while not stop():
+                try:
+                    batch_queue.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def priority_loop():
         while not stop():
@@ -198,7 +215,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                     timeout=0.1)
             except queue.Empty:
                 continue
-            buffer.update_priorities(idxes, priorities, old_ptr, loss)
+            with tracer.span("buffer.update_priorities"):
+                buffer.update_priorities(idxes, priorities, old_ptr, loss)
 
     logs: List[Dict[str, Any]] = []
 
@@ -211,6 +229,9 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 continue
             s = buffer.stats()
             dt = now - last_time
+            tracer.gauge("batch_queue_depth", batch_queue.qsize())
+            tracer.gauge("priority_queue_depth", priority_queue.qsize())
+            tracer.gauge("buffer_fill", s["size"])
             entry = dict(
                 time=now, buffer_size=s["size"], env_steps=s["env_steps"],
                 training_steps=s["training_steps"],
@@ -218,6 +239,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 mean_episode_return=(s["episode_reward"] / s["num_episodes"]
                                      if s["num_episodes"] else float("nan")),
                 mean_loss=(s["sum_loss"] / max(1, s["training_steps"] - last_steps)),
+                trace=tracer.snapshot(),
+                health=supervisor.health(),
             )
             logs.append(entry)
             if log_sink is not None:
@@ -231,14 +254,9 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                       f"loss={entry['mean_loss']:.4f}", flush=True)
             last_steps, last_time = s["training_steps"], now
 
-    threads = [
-        threading.Thread(target=actor_loop, daemon=True, name="actor"),
-        threading.Thread(target=sample_loop, daemon=True, name="sample"),
-        threading.Thread(target=priority_loop, daemon=True, name="priority"),
-        threading.Thread(target=log_loop, daemon=True, name="log"),
-    ]
-    for t in threads:
-        t.start()
+    for name, loop in (("actor", actor_loop), ("sample", sample_loop),
+                       ("priority", priority_loop), ("log", log_loop)):
+        supervisor.start(name, loop)
 
     def batch_source():
         while not stop():
@@ -258,11 +276,12 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 continue
 
     try:
-        metrics = learner.run(batch_source, priority_sink, stop=stop)
+        with device_profile(profile_dir):
+            metrics = learner.run(batch_source, priority_sink, stop=stop,
+                                  tracer=tracer)
     finally:
         stop_event.set()
-        for t in threads:
-            t.join(timeout=5.0)
+        supervisor.join_all(timeout=5.0)
 
     # drain remaining priority feedback so buffer counters are final
     while True:
@@ -274,5 +293,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     metrics.update(buffer_size=len(buffer), logs=logs,
                    buffer_training_steps=buffer.training_steps,
-                   final_params=learner.state.params)
+                   final_params=learner.state.params,
+                   trace=tracer.snapshot(), health=supervisor.health(),
+                   fabric_failed=supervisor.any_failed)
     return metrics

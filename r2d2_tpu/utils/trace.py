@@ -1,0 +1,114 @@
+"""Tracing / profiling instrumentation (SURVEY.md §5.1).
+
+The reference has no tracing at all — only the buffer process's 10-second
+stdout stats (worker.py:89-106).  This module supplies the TPU-native hooks
+the survey calls for:
+
+- :class:`Tracer` — in-process stage timers and gauges.  Spans record
+  wall-time per pipeline stage (actor inference, batch assembly, H2D
+  staging, learner step, priority feedback) as exponential moving averages
+  with counts; gauges record instantaneous values (queue depths, buffer
+  fill).  A ``snapshot()`` is a plain dict, cheap enough to attach to every
+  log line.
+- :func:`device_profile` — a context manager around ``jax.profiler`` trace
+  capture, producing a TensorBoard-loadable trace of the XLA device
+  timeline for any region of the training loop.
+
+Everything is thread-safe and allocation-light: spans cost two
+``perf_counter`` calls and a lock-free float update per use, so they can
+sit in the hot loop.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class _Stat:
+    __slots__ = ("count", "total", "ewma", "last")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.ewma = 0.0
+        self.last = 0.0
+
+    def update(self, dt: float, alpha: float) -> None:
+        self.count += 1
+        self.total += dt
+        self.last = dt
+        self.ewma = dt if self.count == 1 else (
+            alpha * dt + (1.0 - alpha) * self.ewma)
+
+
+class Tracer:
+    """Stage timers + gauges for the training fabric.
+
+    >>> tracer = Tracer()
+    >>> with tracer.span("learner_step"):
+    ...     ...
+    >>> tracer.gauge("batch_queue", 5)
+    >>> tracer.snapshot()["span.learner_step.ewma_ms"]
+    """
+
+    def __init__(self, alpha: float = 0.05):
+        self._alpha = alpha
+        self._spans: Dict[str, _Stat] = {}
+        self._gauges: Dict[str, float] = {}
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                stat = self._spans.get(name)
+                if stat is None:
+                    stat = self._spans[name] = _Stat()
+                stat.update(dt, self._alpha)
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def incr(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict: span.<name>.{ewma_ms,mean_ms,count}, gauge.<name>,
+        counter.<name>."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for name, s in self._spans.items():
+                out[f"span.{name}.ewma_ms"] = s.ewma * 1e3
+                out[f"span.{name}.mean_ms"] = (s.total / s.count) * 1e3
+                out[f"span.{name}.count"] = s.count
+            for name, v in self._gauges.items():
+                out[f"gauge.{name}"] = v
+            for name, v in self._counters.items():
+                out[f"counter.{name}"] = v
+        return out
+
+
+@contextlib.contextmanager
+def device_profile(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a ``jax.profiler`` device trace into ``log_dir`` (viewable
+    in TensorBoard / Perfetto).  No-op when ``log_dir`` is None, so call
+    sites can be unconditional."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,131 @@
+"""Tests for the auxiliary subsystems: tracing (utils/trace.py) and
+failure detection / supervised threads (utils/supervisor.py)."""
+import threading
+import time
+
+import pytest
+
+from r2d2_tpu.utils.supervisor import Supervisor
+from r2d2_tpu.utils.trace import Tracer, device_profile
+
+
+def test_tracer_spans_and_gauges():
+    tr = Tracer()
+    for _ in range(3):
+        with tr.span("work"):
+            time.sleep(0.002)
+    tr.gauge("queue_depth", 5)
+    tr.incr("batches")
+    tr.incr("batches", 2)
+    snap = tr.snapshot()
+    assert snap["span.work.count"] == 3
+    assert snap["span.work.mean_ms"] >= 1.0
+    assert snap["span.work.ewma_ms"] > 0
+    assert snap["gauge.queue_depth"] == 5
+    assert snap["counter.batches"] == 3
+
+
+def test_tracer_span_records_on_exception():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    assert tr.snapshot()["span.boom.count"] == 1
+
+
+def test_tracer_thread_safety():
+    tr = Tracer()
+
+    def worker():
+        for _ in range(200):
+            with tr.span("s"):
+                pass
+            tr.incr("n")
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = tr.snapshot()
+    assert snap["span.s.count"] == 800
+    assert snap["counter.n"] == 800
+
+
+def test_device_profile_noop_without_dir():
+    with device_profile(None):
+        pass  # must not touch jax at all
+
+
+def test_supervisor_restarts_crashing_thread():
+    crashes = []
+    done = threading.Event()
+
+    def loop():
+        if len(crashes) < 2:
+            crashes.append(1)
+            raise RuntimeError("transient")
+        done.set()
+
+    sup = Supervisor(max_restarts=3, backoff=0.01)
+    sup.start("flaky", loop)
+    assert done.wait(5.0), "thread was not restarted to completion"
+    assert not sup.any_failed
+    h = sup.health()["flaky"]
+    assert h["restarts"] == 2
+    assert "transient" in h["last_error"]
+
+
+def test_supervisor_gives_up_after_budget():
+    def loop():
+        raise RuntimeError("permanent")
+
+    sup = Supervisor(max_restarts=2, backoff=0.01)
+    sup.start("dead", loop)
+    deadline = time.time() + 5.0
+    while not sup.any_failed and time.time() < deadline:
+        time.sleep(0.01)
+    assert sup.any_failed
+    h = sup.health()["dead"]
+    assert h["gave_up"] and h["restarts"] == 2
+
+
+def test_supervisor_join_all_cancels_pending_restart():
+    """A crash during shutdown must not resurrect the loop after join_all."""
+    runs = []
+
+    def loop():
+        runs.append(1)
+        raise RuntimeError("crash at shutdown")
+
+    sup = Supervisor(max_restarts=5, backoff=0.2)
+    sup.start("late", loop)
+    time.sleep(0.05)  # first run crashed; a 0.2s restart timer is pending
+    sup.join_all(timeout=2.0)
+    n = len(runs)
+    time.sleep(0.5)  # well past the backoff — no restart may fire
+    assert len(runs) == n
+    assert not sup.threads["late"].alive
+
+
+def test_config_rejects_pallas_with_remat():
+    from r2d2_tpu.config import test_config
+
+    with pytest.raises(ValueError, match="remat"):
+        test_config(lstm_impl="pallas", remat=True)
+
+
+def test_supervisor_healthy_thread_runs_clean():
+    stop = threading.Event()
+
+    def loop():
+        stop.wait(5.0)
+
+    sup = Supervisor()
+    sup.start("ok", loop)
+    time.sleep(0.05)
+    h = sup.health()["ok"]
+    assert h["alive"] and h["restarts"] == 0 and h["last_error"] is None
+    stop.set()
+    sup.join_all(timeout=2.0)
+    assert not sup.any_failed
