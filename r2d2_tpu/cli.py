@@ -103,6 +103,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="resume from the latest checkpoint in --ckpt-dir")
     pt.add_argument("--mesh", action="store_true",
                     help="data-parallel learner over all visible devices")
+    pt.add_argument("--distributed", action="store_true",
+                    help="join the multi-host JAX runtime first "
+                         "(jax.distributed via JAX_COORDINATOR_ADDRESS / "
+                         "JAX_NUM_PROCESSES / JAX_PROCESS_ID, or TPU-pod "
+                         "autodetection); implies --mesh")
     pt.add_argument("--sync", action="store_true",
                     help="deterministic single-thread trainer (debug)")
     pt.add_argument("--max-wall-seconds", type=float, default=None)
@@ -136,10 +141,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.sync and args.max_wall_seconds is not None:
             parser.error("--max-wall-seconds is not supported with --sync "
                          "(the deterministic trainer runs to training_steps)")
+        if args.distributed:
+            from r2d2_tpu.parallel.distributed import init_distributed
+
+            info = init_distributed()
+            print(json.dumps(dict(distributed=info)), flush=True)
         fn = train_sync if args.sync else train
         kwargs: Dict[str, Any] = dict(
             checkpoint_dir=args.ckpt_dir, resume=args.resume,
-            use_mesh=args.mesh)
+            use_mesh=args.mesh or args.distributed)
         if not args.sync:
             kwargs.update(max_wall_seconds=args.max_wall_seconds,
                           verbose=not args.quiet)

@@ -86,11 +86,22 @@ class Learner:
 
     def _stage(self, batch: Dict[str, np.ndarray]
                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-        """Split host bookkeeping from device fields and start the H2D copy."""
+        """Split host bookkeeping from device fields and start the H2D copy.
+
+        Multi-host: each process's batch holds only its dp rows, assembled
+        into one global sharded array (parallel/distributed.py) — batch
+        data never crosses DCN."""
         host = {k: batch[k] for k in batch if k not in DEVICE_BATCH_KEYS}
         if self._shardings is not None:
-            dev = {k: jax.device_put(batch[k], self._shardings[k])
-                   for k in DEVICE_BATCH_KEYS}
+            if jax.process_count() > 1:
+                from r2d2_tpu.parallel.distributed import host_local_batch
+
+                dev = host_local_batch(
+                    self.mesh, {k: batch[k] for k in DEVICE_BATCH_KEYS},
+                    shardings=self._shardings)
+            else:
+                dev = {k: jax.device_put(batch[k], self._shardings[k])
+                       for k in DEVICE_BATCH_KEYS}
         else:
             dev = {k: jax.device_put(batch[k]) for k in DEVICE_BATCH_KEYS}
         return dev, host
@@ -165,10 +176,24 @@ class Learner:
                 batch = batch_source()
                 return None if batch is None else self._stage(batch)
 
+        # multi-host: stop decisions (wall-clock deadlines, fabric
+        # failures) are host-local, but leaving the step loop early on one
+        # host would deadlock the others' collectives — sync the flag so
+        # all hosts break at the same step boundary
+        if jax.process_count() > 1:
+            from r2d2_tpu.parallel.distributed import sync_counter
+
+            def should_stop() -> bool:
+                local = bool(stop()) if stop is not None else False
+                return sync_counter(int(local), reduce="max") > 0
+        else:
+            def should_stop() -> bool:
+                return stop is not None and stop()
+
         losses = []
         try:
             while self.num_updates < target:
-                if stop is not None and stop():
+                if should_stop():
                     break
                 with tracer.span("learner.batch_wait"):
                     item = next_item()
@@ -178,10 +203,18 @@ class Learner:
                 with tracer.span("learner.step_dispatch"):
                     self.state, loss, priorities = self._step_fn(self.state,
                                                                  dev_batch)
-                # one device→host sync per step: loss + priorities together
+                # one device→host sync per step: loss + priorities together.
+                # loss is replicated (addressable everywhere); priorities
+                # are dp-sharded, so under a mesh read back only this
+                # host's rows — they pair with the idxes this host sampled
                 with tracer.span("learner.result_sync"):
                     loss = float(jax.device_get(loss))
-                    priorities = np.asarray(jax.device_get(priorities))
+                    if self.mesh is not None:
+                        from r2d2_tpu.parallel.distributed import local_rows
+
+                        priorities = local_rows(priorities)
+                    else:
+                        priorities = np.asarray(jax.device_get(priorities))
                 losses.append(loss)
                 self.env_steps = int(host.get("env_steps", self.env_steps))
 
@@ -202,6 +235,10 @@ class Learner:
         if self.checkpointer is not None:
             self._save(self.num_updates, t0)
         mins = self.start_minutes + (time.time() - t0) / 60.0
+        if jax.process_count() > 1:
+            from r2d2_tpu.parallel.distributed import sync_counter
+
+            self.env_steps = sync_counter(self.env_steps, reduce="sum")
         return dict(
             num_updates=self.num_updates,
             env_steps=self.env_steps,
@@ -211,7 +248,18 @@ class Learner:
 
     def _save(self, updates: int, t0: float) -> None:
         minutes = self.start_minutes + (time.time() - t0) / 60.0
-        self.checkpointer.save(updates, jax.device_get(self.state),
+        if jax.process_count() > 1:
+            # gather mp-sharded leaves that may live on other hosts, then
+            # write from process 0 only (concurrent orbax writes to one
+            # path would race)
+            from jax.experimental import multihost_utils
+
+            state = multihost_utils.process_allgather(self.state)
+            if jax.process_index() != 0:
+                return
+        else:
+            state = jax.device_get(self.state)
+        self.checkpointer.save(updates, state,
                                meta=dict(env_steps=self.env_steps,
                                          minutes=minutes,
                                          game=self.cfg.game_name))

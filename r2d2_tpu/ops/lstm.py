@@ -75,6 +75,40 @@ def _fwd_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
     c_scr[:] = c_new
 
 
+def _fwd_infer_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
+                      hs_ref, cT_ref, h_scr, c_scr, *, compute_dtype):
+    """Residual-free forward for the primal (inference) path: same math as
+    :func:`_fwd_kernel` but without streaming gates/cell states to HBM —
+    actors and evaluators only need hs and the final (h, c)."""
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    c = c_scr[:]
+    H = h.shape[-1]
+    gates = xp_ref[0] + jnp.dot(h.astype(compute_dtype), wh_ref[:],
+                                preferred_element_type=jnp.float32)
+    si = _sigmoid(gates[:, 0 * H:1 * H])
+    sf = _sigmoid(gates[:, 1 * H:2 * H])
+    tg = jnp.tanh(gates[:, 2 * H:3 * H])
+    so = _sigmoid(gates[:, 3 * H:4 * H])
+    c_new = sf * c + si * tg
+    h_new = so * jnp.tanh(c_new)
+
+    hs_ref[0] = h_new
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+
+    @pl.when(t == T - 1)
+    def _():
+        cT_ref[:] = c_new
+
+
 def _bwd_kernel(dhs_ref, dcT_ref, wh_ref, gates_ref, cs_ref, hprev_ref,
                 cprev_ref, dxp_ref, dwh_ref, dh0_ref, dc0_ref,
                 dh_scr, dc_scr, dwh_scr, *, compute_dtype):
@@ -182,6 +216,37 @@ def make_lstm_unroll(compute_dtype: Any, interpret: bool):
         )(xp, wh, h0.astype(f32), c0.astype(f32))
         return hs, cs, gates
 
+    def _infer_call(xp, wh, h0, c0):
+        T, B, H4 = xp.shape
+        H = H4 // 4
+        f32 = jnp.float32
+        kernel = functools.partial(_fwd_infer_kernel, compute_dtype=cd)
+        mem = {} if interpret else dict(memory_space=_VMEM)
+        hs, cT = pl.pallas_call(
+            kernel,
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0), **mem),
+                pl.BlockSpec((H, H4), lambda t: (0, 0), **mem),
+                pl.BlockSpec((B, H), lambda t: (0, 0), **mem),
+                pl.BlockSpec((B, H), lambda t: (0, 0), **mem),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, B, H), lambda t: (t, 0, 0), **mem),
+                pl.BlockSpec((B, H), lambda t: (0, 0), **mem),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, B, H), f32),
+                jax.ShapeDtypeStruct((B, H), f32),
+            ],
+            scratch_shapes=[
+                _scratch((B, H)),
+                _scratch((B, H)),
+            ],
+            interpret=interpret,
+        )(xp, wh, h0.astype(f32), c0.astype(f32))
+        return hs, cT
+
     def _bwd_call(wh, hs, cs, gates, h0, c0, dhs, dcT):
         T, B, H = hs.shape
         H4 = 4 * H
@@ -227,8 +292,11 @@ def make_lstm_unroll(compute_dtype: Any, interpret: bool):
 
     @jax.custom_vjp
     def lstm_unroll(xp, wh, h0, c0):
-        hs, cs, gates = _fwd_call(xp, wh, h0, c0)
-        return hs, hs[-1], cs[-1]
+        # primal (inference) path: no backward will run, so skip the
+        # gates/cs residual streams — ~6x less HBM write traffic for the
+        # actor/eval unrolls.  fwd() below is what grad tracing uses.
+        hs, cT = _infer_call(xp, wh, h0, c0)
+        return hs, hs[-1], cT
 
     def fwd(xp, wh, h0, c0):
         hs, cs, gates = _fwd_call(xp, wh, h0, c0)

@@ -1,3 +1,9 @@
+from r2d2_tpu.parallel.distributed import (
+    dp_rows_for_process,
+    host_local_batch,
+    init_distributed,
+    sync_counter,
+)
 from r2d2_tpu.parallel.mesh import (
     batch_sharding,
     make_mesh,
@@ -8,8 +14,12 @@ from r2d2_tpu.parallel.mesh import (
 
 __all__ = [
     "batch_sharding",
+    "dp_rows_for_process",
+    "host_local_batch",
+    "init_distributed",
     "make_mesh",
     "replicated",
     "shard_batch",
     "sharded_train_step",
+    "sync_counter",
 ]

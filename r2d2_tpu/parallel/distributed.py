@@ -1,0 +1,180 @@
+"""Multi-host distributed runtime (SURVEY.md §5.8).
+
+The reference's "distributed backend" is single-host
+``torch.multiprocessing`` queues + shared memory (train.py:23-26); it has no
+multi-node story at all.  The TPU-native equivalent splits cleanly:
+
+- **Within the learner step**: nothing here — gradient/metric collectives
+  are GSPMD-inserted ``psum``s over the mesh (parallel/mesh.py) and ride
+  ICI within a slice and DCN across slices automatically.
+- **Process bring-up**: :func:`init_distributed` wraps
+  ``jax.distributed.initialize`` so N host processes (one per TPU host)
+  form a single JAX runtime whose ``jax.devices()`` is the global device
+  set.  After it returns, ``make_mesh`` over ``jax.devices()`` is a global
+  mesh and the existing ``sharded_train_step`` compiles unchanged.
+- **Host-side data plane**: replay stays host-local (each host's actor
+  fleet feeds its own buffer — the analogue of the reference's per-actor
+  queues staying on one box).  ``cfg.batch_size`` remains the **global**
+  batch: each host samples only :func:`host_batch_size` rows (its share of
+  the dp axis) and :func:`host_local_batch` assembles them into one
+  globally sharded device batch via
+  ``jax.make_array_from_process_local_data`` — no batch data ever crosses
+  DCN.  The step's dp-sharded priority output comes back through
+  :func:`local_rows`, which reads only this host's addressable shards, so
+  each host's priority feedback aligns with the indexes it sampled.
+
+Single-process (tests, the one-chip bench) is the degenerate case: every
+helper reduces to the identity / a sharded ``device_put``, which is how the
+whole path is unit tested on the 8-device CPU mesh — the single-process
+code path IS the multi-host code path.
+
+Topology assumption (asserted): each host's devices cover whole dp groups,
+contiguously — true for standard pod slices where the mesh is built from
+``jax.devices()`` in order (make_mesh).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.parallel.mesh import DEVICE_BATCH_KEYS, batch_sharding
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     auto: bool = False) -> Dict[str, int]:
+    """Join (or create) the multi-host JAX runtime.
+
+    Must run before any other JAX call in the process (XLA backend
+    initialisation pins the runtime) — the CLI's ``--distributed`` flag
+    calls it first thing.  Arguments default to the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+    ``JAX_PROCESS_ID``).  With ``auto=True`` (the CLI's behaviour) and no
+    coordinator configured, ``jax.distributed.initialize()`` is called
+    bare so TPU pods autodetect all three from the metadata server — an
+    explicit distributed request never silently degrades to N independent
+    single-host runs.  With ``auto=False`` (library default) and no
+    coordinator, it is a no-op so single-process use needs no guards.
+
+    Returns ``{"process_id": ..., "process_count": ...}``.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    # NOTE: nothing before initialize() may touch the backend
+    # (jax.devices(), jax.process_count(), ...) or it would raise
+    if not jax.distributed.is_initialized():
+        if coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        elif auto:
+            try:
+                jax.distributed.initialize()  # TPU-pod autodetection
+            except Exception as e:
+                raise RuntimeError(
+                    "distributed bring-up requested but no coordinator is "
+                    "configured and autodetection failed; set "
+                    "JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / "
+                    "JAX_PROCESS_ID") from e
+    return dict(process_id=jax.process_index(),
+                process_count=jax.process_count())
+
+
+def dp_rows_for_process(mesh: Mesh, global_batch: int) -> slice:
+    """The contiguous slice of the global batch this process's devices own.
+
+    Rows are sharded over the ``dp`` axis wherever it sits in the mesh; a
+    dp group's row-shard is replicated over the remaining axes.  Asserts
+    the topology assumption from the module docstring: this process's dp
+    groups are whole (all-local or all-remote) and contiguous.
+    """
+    axis = mesh.axis_names.index("dp")
+    dp = mesh.shape["dp"]
+    groups = np.moveaxis(mesh.devices, axis, 0).reshape(dp, -1)
+    local_ids = {d.id for d in jax.local_devices()}
+    owned = []
+    for i in range(dp):
+        n_local = sum(d.id in local_ids for d in groups[i])
+        # real errors, not asserts: this alignment is load-bearing for
+        # priority/index pairing and must survive python -O
+        if n_local not in (0, groups.shape[1]):
+            raise RuntimeError(
+                f"dp group {i} is split across processes; re-order mesh "
+                f"axes so dp groups are host-aligned")
+        if n_local:
+            owned.append(i)
+    if not owned:
+        return slice(0, 0)
+    if owned != list(range(owned[0], owned[-1] + 1)):
+        raise RuntimeError(
+            f"process owns non-contiguous dp groups {owned}; re-order mesh "
+            f"axes so each host's dp rows are contiguous")
+    per = global_batch // dp
+    return slice(owned[0] * per, (owned[-1] + 1) * per)
+
+
+def host_batch_size(cfg: Config, mesh: Mesh) -> int:
+    """How many rows of the global ``cfg.batch_size`` this host samples
+    from its local replay buffer.  Single-process: ``cfg.batch_size``."""
+    rows = dp_rows_for_process(mesh, cfg.batch_size)
+    return rows.stop - rows.start
+
+
+def host_local_batch(mesh: Mesh, local_batch: Dict[str, np.ndarray],
+                     shardings: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Build the globally dp-sharded device batch from per-process data.
+
+    ``local_batch`` holds only this process's rows (``host_batch_size`` of
+    them).  Single-process, the local rows are the whole batch and the
+    result equals a sharded ``jax.device_put``.  Pass cached ``shardings``
+    (``batch_sharding(mesh)``) from hot paths to avoid rebuilding them
+    per step.
+    """
+    if shardings is None:
+        shardings = batch_sharding(mesh)
+    return {
+        k: jax.make_array_from_process_local_data(shardings[k],
+                                                  local_batch[k])
+        for k in DEVICE_BATCH_KEYS
+    }
+
+
+def local_rows(arr: jax.Array) -> np.ndarray:
+    """This process's rows of a leading-axis-sharded global array.
+
+    Reads only addressable shards (a multi-host ``device_get`` of the full
+    array would fail), ordered by global row index and deduplicated (a
+    shard replicated over non-dp axes appears once per replica).
+    Single-process this equals ``device_get`` of the whole array.
+    """
+    rows: Dict[int, np.ndarray] = {}
+    for shard in arr.addressable_shards:
+        start = shard.index[0].start or 0
+        if start not in rows:
+            rows[start] = np.asarray(shard.data)
+    return np.concatenate([rows[s] for s in sorted(rows)], axis=0)
+
+
+def sync_counter(value: int, reduce: str = "max") -> int:
+    """All-process reduction of a host counter (e.g. env_steps, buffer
+    size) — a device-mediated allgather so hosts agree on progress without
+    a side channel.  Single-process it is the identity."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    vals = np.asarray(multihost_utils.process_allgather(
+        np.asarray(value, np.int64)))
+    return int(vals.max() if reduce == "max" else vals.sum())

@@ -74,6 +74,15 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
         start_minutes = float(meta.get("minutes", 0.0))
 
     mesh = make_mesh(cfg) if use_mesh else None
+    if mesh is not None:
+        from r2d2_tpu.parallel.distributed import host_batch_size
+
+        # cfg.batch_size is the GLOBAL batch; this host samples only its
+        # dp-axis share from its local buffer (single-process: the whole
+        # batch)
+        host_bs = host_batch_size(cfg, mesh)
+    else:
+        host_bs = cfg.batch_size
     param_store = ParamStore()
     learner = Learner(cfg, net, state, mesh=mesh, param_store=param_store,
                       checkpointer=checkpointer,
@@ -90,7 +99,7 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                         rng=np.random.default_rng(cfg.seed + 7919))
     return dict(envs=envs, action_dim=action_dim, net=net, learner=learner,
                 buffer=buffer, actor=actor, param_store=param_store,
-                checkpointer=checkpointer)
+                checkpointer=checkpointer, host_bs=host_bs)
 
 
 # --------------------------------------------------------------------------
@@ -123,7 +132,7 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     def batch_source():
         actor.run(max_steps=actor_steps_per_update)
-        return buffer.sample_batch()
+        return buffer.sample_batch(sys["host_bs"])
 
     def priority_sink(idxes, priorities, old_ptr, loss):
         buffer.update_priorities(idxes, priorities, old_ptr, loss)
@@ -200,7 +209,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 time.sleep(0.05)
                 continue
             with tracer.span("buffer.sample_batch"):
-                batch = buffer.sample_batch()
+                batch = buffer.sample_batch(sys["host_bs"])
             while not stop():
                 try:
                     batch_queue.put(batch, timeout=0.1)

@@ -1,0 +1,110 @@
+"""Multi-host helpers (parallel/distributed.py), exercised single-process
+on the 8-device CPU mesh — the degenerate case the helpers promise to
+handle identically."""
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.parallel.distributed import (
+    dp_rows_for_process,
+    host_batch_size,
+    host_local_batch,
+    init_distributed,
+    local_rows,
+    sync_counter,
+)
+from r2d2_tpu.parallel.mesh import (
+    DEVICE_BATCH_KEYS,
+    make_mesh,
+    shard_batch,
+)
+from r2d2_tpu.utils.batch import synthetic_batch
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    cfg = make_test_config(mesh_shape=(("dp", 4),))
+    return make_mesh(cfg)
+
+
+def test_init_distributed_single_process():
+    info = init_distributed()  # no coordinator configured → no-op
+    assert info == {"process_id": 0, "process_count": 1}
+
+
+def test_dp_rows_single_process_owns_everything(mesh):
+    assert dp_rows_for_process(mesh, 8) == slice(0, 8)
+
+
+def test_host_local_batch_matches_device_put(mesh):
+    cfg = make_test_config(mesh_shape=(("dp", 4),))
+    rng = np.random.default_rng(0)
+    batch = synthetic_batch(cfg, 4, rng)
+    local = {k: batch[k] for k in DEVICE_BATCH_KEYS}
+
+    global_arrays = host_local_batch(mesh, local)
+    reference = shard_batch(mesh, batch)
+    for k in DEVICE_BATCH_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(global_arrays[k])),
+            np.asarray(jax.device_get(reference[k])), err_msg=k)
+        assert global_arrays[k].sharding == reference[k].sharding, k
+
+
+def test_host_local_batch_feeds_sharded_step(mesh):
+    """The assembled global batch must be consumable by the real sharded
+    train step (end-to-end device-batch path of a multi-host learner)."""
+    from r2d2_tpu.learner.step import create_train_state
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.parallel.mesh import replicate_state, sharded_train_step
+
+    cfg = make_test_config(mesh_shape=(("dp", 4),), batch_size=8)
+    net = create_network(cfg, 4)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    state = replicate_state(mesh, create_train_state(cfg, params))
+    step = sharded_train_step(cfg, net, mesh)
+
+    rng = np.random.default_rng(1)
+    batch = synthetic_batch(cfg, 4, rng)
+    dev = host_local_batch(mesh, {k: batch[k] for k in DEVICE_BATCH_KEYS})
+    state, loss, priorities = step(state, dev)
+    assert np.isfinite(float(jax.device_get(loss)))
+    assert np.asarray(jax.device_get(priorities)).shape == (8,)
+
+
+def test_sync_counter_identity_single_process():
+    assert sync_counter(1234) == 1234
+    assert sync_counter(7, reduce="sum") == 7
+
+
+def test_host_batch_size_single_process_is_global(mesh):
+    cfg = make_test_config(mesh_shape=(("dp", 4),), batch_size=8)
+    assert host_batch_size(cfg, mesh) == 8
+
+
+def test_local_rows_roundtrip_dp_sharded(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    arr = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    np.testing.assert_array_equal(local_rows(arr), x)
+
+
+def test_local_rows_dedups_replicated_axis():
+    """With a 2-D (dp, mp) mesh, each dp row-shard is replicated across mp
+    devices; local_rows must return each row range exactly once."""
+    cfg = make_test_config(mesh_shape=(("dp", 2), ("mp", 2)))
+    m = make_mesh(cfg)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = np.arange(4 * 2, dtype=np.float32).reshape(4, 2)
+    arr = jax.device_put(x, NamedSharding(m, P("dp")))
+    np.testing.assert_array_equal(local_rows(arr), x)
+
+
+def test_dp_rows_with_trailing_dp_axis():
+    """dp need not be the leading mesh axis."""
+    cfg = make_test_config(mesh_shape=(("mp", 2), ("dp", 2)))
+    m = make_mesh(cfg)
+    assert dp_rows_for_process(m, 8) == slice(0, 8)
