@@ -109,7 +109,7 @@ class VectorActor:
         self._params = None
 
         # batched AgentState
-        self.obs = np.zeros((self.N, *cfg.obs_shape), np.uint8)
+        self.obs = np.zeros((self.N, *cfg.stored_obs_shape), np.uint8)
         self.last_action = np.zeros((self.N, self.action_dim), np.float32)
         self.last_reward = np.zeros(self.N, np.float32)
         self.hidden = np.zeros((self.N, 2, cfg.lstm_layers, cfg.hidden_dim),

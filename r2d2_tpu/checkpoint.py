@@ -57,6 +57,16 @@ class Checkpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def peek_meta(self, step: Optional[int] = None) -> Dict[str, Any]:
+        """Read a checkpoint's metadata sidecar without touching the state
+        (for pre-restore validation)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None or not os.path.exists(self._meta_path(step)):
+            return {}
+        with open(self._meta_path(step)) as f:
+            return json.load(f)
+
     def restore(self, state_template: Any, step: Optional[int] = None
                 ) -> Tuple[Any, Dict[str, Any]]:
         """Restore ``step`` (default latest) shaped like ``state_template``."""
@@ -70,3 +80,34 @@ class Checkpointer:
             with open(self._meta_path(step)) as f:
                 meta = json.load(f)
         return state, meta
+
+
+# config fields that change parameter shapes; recorded in the checkpoint
+# metadata sidecar and validated before restore so a mismatch fails with an
+# actionable message instead of an opaque orbax shape error
+ARCH_FIELDS = ("obs_space_to_depth", "obs_shape", "torso", "hidden_dim",
+               "lstm_layers")
+
+
+def arch_meta(cfg: Any) -> Dict[str, Any]:
+    return {f: getattr(cfg, f) for f in ARCH_FIELDS}
+
+
+def check_arch_compat(cfg: Any, meta: Dict[str, Any]) -> None:
+    """Raise if the checkpoint was written under a different network
+    architecture than ``cfg`` describes.  Metas from before this guard
+    (no recorded fields) pass through."""
+    mismatches = []
+    for f in ARCH_FIELDS:
+        if f in meta:
+            want, have = meta[f], getattr(cfg, f)
+            if isinstance(have, tuple):
+                have = list(have)
+            if want != have:
+                mismatches.append(f"{f}: checkpoint={want!r} config={have!r}")
+    if mismatches:
+        raise ValueError(
+            "checkpoint/config architecture mismatch — restore would fail "
+            "or load garbage. Align the config (e.g. --set "
+            "obs_space_to_depth=False) or use a fresh checkpoint dir:\n  "
+            + "\n  ".join(mismatches))

@@ -21,7 +21,7 @@ from typing import Optional
 import numpy as np
 
 from r2d2_tpu.config import Config
-from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.envs.fake import FakeAtariEnv, _Box
 
 try:  # gymnasium is baked in; the ALE plugin may not be
     import gymnasium
@@ -84,8 +84,7 @@ class WarpFrame:
         self.env = env
         self._width = width
         self._height = height
-        self.observation_space = type(
-            "Box", (), {"shape": (height, width, 1), "dtype": np.uint8})()
+        self.observation_space = _Box((height, width, 1), np.uint8)
 
     def __getattr__(self, name):
         return getattr(self.env, name)
@@ -104,11 +103,47 @@ class WarpFrame:
         return self._warp(obs), reward, terminated, truncated, info
 
 
+class SpaceToDepth:
+    """Fold 4×4 pixel blocks into channels: (H, W, C) uint8 →
+    (H/4, W/4, 16C) uint8.
+
+    Applied host-side at emission so the device never pays the relayout
+    (the on-device transform of a training batch costs more than the conv
+    it feeds — see NatureTorso docstring).  A ~7 KB numpy transpose per
+    env step.
+    """
+
+    def __init__(self, env):
+        self.env = env
+        h, w, c = env.observation_space.shape
+        self.observation_space = _Box((h // 4, w // 4, 16 * c), np.uint8)
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    @staticmethod
+    def fold(obs: np.ndarray) -> np.ndarray:
+        h, w, c = obs.shape
+        obs = obs.reshape(h // 4, 4, w // 4, 4, c)
+        return np.ascontiguousarray(
+            obs.transpose(0, 2, 1, 3, 4)).reshape(h // 4, w // 4, 16 * c)
+
+    def reset(self, **kwargs):
+        obs, info = self.env.reset(**kwargs)
+        return self.fold(obs), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self.fold(obs), reward, terminated, truncated, info
+
+
 def create_env(cfg: Config, noop_start: bool = True,
                seed: Optional[int] = None):
     """The single env factory (reference: environment.py:66-74).
 
-    ``cfg.game_name == "Fake"`` or missing ALE → :class:`FakeAtariEnv`.
+    ``cfg.game_name == "Fake"`` or missing ALE → :class:`FakeAtariEnv`
+    (emitting ``cfg.stored_obs_shape`` directly — the fake env's content
+    is seed-derived noise either way).
     """
     if cfg.game_name == "Fake" or not _HAS_ALE:
         if cfg.game_name != "Fake":
@@ -117,8 +152,7 @@ def create_env(cfg: Config, noop_start: bool = True,
             warnings.warn(
                 f"ALE not installed; substituting FakeAtariEnv for "
                 f"{cfg.game_name!r}", stacklevel=2)
-        h, w = cfg.obs_shape[0], cfg.obs_shape[1]
-        return FakeAtariEnv(obs_shape=(h, w, 1), action_dim=4,
+        return FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=4,
                             seed=0 if seed is None else seed)
 
     env = gymnasium.make(
@@ -129,4 +163,6 @@ def create_env(cfg: Config, noop_start: bool = True,
     if noop_start:
         env = NoopResetEnv(env, noop_max=cfg.noop_max,
                            rng=np.random.default_rng(seed))
+    if cfg.obs_space_to_depth:
+        env = SpaceToDepth(env)
     return env

@@ -38,7 +38,7 @@ def run_episodes(cfg: Config, net: R2D2Network, params: Any,
     N = len(envs)
     action_dim = envs[0].action_space.n
 
-    obs = np.zeros((N, *cfg.obs_shape), np.uint8)
+    obs = np.zeros((N, *cfg.stored_obs_shape), np.uint8)
     last_action = np.zeros((N, action_dim), np.float32)
     last_reward = np.zeros(N, np.float32)
     hidden = np.zeros((N, 2, cfg.lstm_layers, cfg.hidden_dim), np.float32)
@@ -104,6 +104,9 @@ def evaluate_sweep(cfg: Config,
 
     curve: List[Dict[str, float]] = []
     for step in ckpt.steps():
+        from r2d2_tpu.checkpoint import check_arch_compat
+
+        check_arch_compat(cfg, ckpt.peek_meta(step))
         raw, meta = ckpt.restore(None, step=step)
         params = raw["params"]  # the flax variables dict of the online net
         mean_reward = evaluate_params(cfg, net, params, env_factory,

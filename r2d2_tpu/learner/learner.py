@@ -259,7 +259,10 @@ class Learner:
                 return
         else:
             state = jax.device_get(self.state)
+        from r2d2_tpu.checkpoint import arch_meta
+
         self.checkpointer.save(updates, state,
                                meta=dict(env_steps=self.env_steps,
                                          minutes=minutes,
-                                         game=self.cfg.game_name))
+                                         game=self.cfg.game_name,
+                                         **arch_meta(self.cfg)))

@@ -40,16 +40,31 @@ def _dtype(name: str):
 
 
 class NatureTorso(nn.Module):
-    """Nature-DQN conv stack (reference geometry: model.py:39-49), NHWC."""
+    """Nature-DQN conv stack (reference geometry: model.py:39-49), NHWC.
+
+    With ``s2d_input`` the input arrives space-to-depth folded from the
+    host pipeline ((21, 21, 16) for an 84×84 frame — cfg.stored_obs_shape)
+    and conv1 is the equivalent 2×2 stride-1 conv: the same linear map as
+    8×8 stride-4 on raw pixels (every 8×8/4 window is a 2×2 window of 4×4
+    blocks; kernel entries permuted — see
+    tests/test_network.py::test_space_to_depth_equals_direct_conv1), but
+    with a 16-deep MXU-shaped contraction instead of the pathological
+    1-channel one, and no device-side relayout (a device transform of the
+    (B·T, 84, 84, 1) batch costs more than conv1 itself).
+    """
     out_dim: int
     compute_dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    s2d_input: bool = False
 
     @nn.compact
     def __call__(self, x):  # x: (B, H, W, C) in [0, 1]
         kw = dict(padding="VALID", dtype=self.compute_dtype,
                   param_dtype=self.param_dtype)
-        x = nn.relu(nn.Conv(32, (8, 8), strides=(4, 4), **kw)(x))
+        if self.s2d_input:
+            x = nn.relu(nn.Conv(32, (2, 2), strides=(1, 1), **kw)(x))
+        else:
+            x = nn.relu(nn.Conv(32, (8, 8), strides=(4, 4), **kw)(x))
         x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2), **kw)(x))
         x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), **kw)(x))
         x = x.reshape(x.shape[0], -1)
@@ -201,8 +216,11 @@ class R2D2Network(nn.Module):
         cd, pd = _dtype(cfg.compute_dtype), _dtype(cfg.param_dtype)
         torso_cls = {"nature": NatureTorso, "impala": ImpalaTorso,
                      "mlp": MlpTorso}[cfg.torso]
-        self.torso = torso_cls(out_dim=cfg.hidden_dim, compute_dtype=cd,
-                               param_dtype=pd)
+        torso_kw = dict(out_dim=cfg.hidden_dim, compute_dtype=cd,
+                        param_dtype=pd)
+        if cfg.torso == "nature":
+            torso_kw["s2d_input"] = cfg.obs_space_to_depth
+        self.torso = torso_cls(**torso_kw)
         impl = resolve_lstm_impl(cfg)
         self.lstm_layers_ = [
             LSTMLayer(hidden_dim=cfg.hidden_dim, compute_dtype=cd,
@@ -274,7 +292,7 @@ def create_network(cfg: Config, action_dim: int) -> R2D2Network:
 
 def init_params(cfg: Config, net: R2D2Network, key: jax.Array):
     B, T = 1, 2
-    obs = jnp.zeros((B, T, *cfg.obs_shape), jnp.uint8)
+    obs = jnp.zeros((B, T, *cfg.stored_obs_shape), jnp.uint8)
     la = jnp.zeros((B, T, net.action_dim), jnp.float32)
     lr = jnp.zeros((B, T), jnp.float32)
     hidden = jnp.zeros((B, 2, cfg.lstm_layers, cfg.hidden_dim), jnp.float32)

@@ -37,7 +37,7 @@ class ReplayBuffer:
         NB, K, MS = cfg.num_blocks, cfg.seqs_per_block, cfg.max_block_steps
         BL, layers, H = cfg.block_length, cfg.lstm_layers, cfg.hidden_dim
 
-        self.obs = np.zeros((NB, MS, *cfg.obs_shape), np.uint8)
+        self.obs = np.zeros((NB, MS, *cfg.stored_obs_shape), np.uint8)
         self.last_action = np.zeros((NB, MS, action_dim), bool)
         self.last_reward = np.zeros((NB, MS), np.float32)
         self.action = np.zeros((NB, BL), np.uint8)

@@ -69,6 +69,9 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
     start_env_steps, start_minutes = 0, 0.0
     if (checkpointer is not None and resume
             and checkpointer.latest_step() is not None):
+        from r2d2_tpu.checkpoint import check_arch_compat
+
+        check_arch_compat(cfg, checkpointer.peek_meta())
         state, meta = checkpointer.restore(jax.device_get(state))
         start_env_steps = int(meta.get("env_steps", 0))
         start_minutes = float(meta.get("minutes", 0.0))

@@ -19,7 +19,7 @@ def synthetic_batch(cfg: Config, action_dim: int,
     """A full-size host batch with every sample at maximal window sizes."""
     B, T, L = cfg.batch_size, cfg.seq_len, cfg.learning_steps
     return dict(
-        obs=rng.integers(0, 256, (B, T, *cfg.obs_shape), dtype=np.uint8),
+        obs=rng.integers(0, 256, (B, T, *cfg.stored_obs_shape), dtype=np.uint8),
         last_action=np.eye(action_dim, dtype=np.float32)[
             rng.integers(0, action_dim, (B, T))],
         last_reward=rng.standard_normal((B, T)).astype(np.float32),

@@ -129,3 +129,27 @@ def test_supervisor_healthy_thread_runs_clean():
     stop.set()
     sup.join_all(timeout=2.0)
     assert not sup.any_failed
+
+
+def test_checkpoint_arch_compat_guard(tmp_path):
+    """A checkpoint written under one network architecture must refuse to
+    restore under another, with an actionable message — not an opaque
+    orbax shape error."""
+    from r2d2_tpu.checkpoint import (
+        Checkpointer, arch_meta, check_arch_compat)
+    from r2d2_tpu.config import test_config as make_test_config
+
+    cfg = make_test_config()
+    ck = Checkpointer(str(tmp_path))
+    ck.save(5, {"x": [1.0, 2.0]}, meta=dict(env_steps=1, **arch_meta(cfg)))
+
+    check_arch_compat(cfg, ck.peek_meta())  # same arch: fine
+    check_arch_compat(cfg, {})              # pre-guard meta: fine
+
+    other = cfg.replace(hidden_dim=cfg.hidden_dim * 2)
+    with pytest.raises(ValueError, match="hidden_dim"):
+        check_arch_compat(other, ck.peek_meta())
+    s2d = make_test_config(obs_shape=(84, 84, 1), torso="nature",
+                           obs_space_to_depth=True)
+    with pytest.raises(ValueError, match="obs_space_to_depth"):
+        check_arch_compat(s2d, ck.peek_meta())

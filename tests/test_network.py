@@ -19,7 +19,7 @@ def build(cfg=None):
 
 
 def random_inputs(cfg, rng, B, T):
-    obs = rng.integers(0, 255, (B, T, *cfg.obs_shape), dtype=np.uint8)
+    obs = rng.integers(0, 255, (B, T, *cfg.stored_obs_shape), dtype=np.uint8)
     la = rng.random((B, T, A)).astype(np.float32)
     lr = rng.random((B, T)).astype(np.float32)
     hidden = rng.normal(size=(B, 2, cfg.lstm_layers, cfg.hidden_dim)).astype(np.float32)
@@ -140,3 +140,57 @@ def test_remat_unroll_identical():
     q1, _ = net1.apply(params, obs, la, lr, hidden, method=R2D2Network.unroll)
     q2, _ = net2.apply(params, obs, la, lr, hidden, method=R2D2Network.unroll)
     np.testing.assert_allclose(np.asarray(q1), np.asarray(q2), rtol=1e-6)
+
+
+def test_space_to_depth_equals_direct_conv1():
+    """Host-side space-to-depth + the 2x2/1 conv computes the same function
+    as the direct 8x8/4 conv on raw pixels: mapping the (8,8,1,32) kernel
+    into the (2,2,16,32) block layout reproduces the output exactly."""
+    import jax
+    from r2d2_tpu.envs.atari import SpaceToDepth
+    from r2d2_tpu.models.network import NatureTorso
+
+    rng = np.random.default_rng(7)
+    x_raw = np.asarray(rng.integers(0, 256, (3, 84, 84, 1)), np.uint8)
+    x_s2d = np.stack([SpaceToDepth.fold(f) for f in x_raw])
+    x_raw_f = jnp.asarray(x_raw, jnp.float32) / 255.0
+    x_s2d_f = jnp.asarray(x_s2d, jnp.float32) / 255.0
+
+    direct = NatureTorso(out_dim=32, s2d_input=False)
+    s2d = NatureTorso(out_dim=32, s2d_input=True)
+    p_direct = direct.init(jax.random.PRNGKey(0), x_raw_f)
+    p_s2d = s2d.init(jax.random.PRNGKey(0), x_s2d_f)
+
+    # rebuild the s2d conv1 kernel from the direct one:
+    # w2[u, v, (pi*4+pj)*C + c, o] = w1[u*4+pi, v*4+pj, c, o]  (C=1)
+    w1 = np.asarray(p_direct["params"]["Conv_0"]["kernel"])  # (8,8,1,32)
+    w2 = np.zeros((2, 2, 16, 32), np.float32)
+    for u in range(2):
+        for v in range(2):
+            for pi in range(4):
+                for pj in range(4):
+                    w2[u, v, pi * 4 + pj] = w1[u * 4 + pi, v * 4 + pj, 0]
+    new_params = dict(p_s2d["params"])
+    new_params["Conv_0"] = dict(kernel=jnp.asarray(w2),
+                                bias=p_direct["params"]["Conv_0"]["bias"])
+    for k in ("Conv_1", "Conv_2", "Dense_0"):
+        new_params[k] = p_direct["params"][k]
+    out_direct = direct.apply(p_direct, x_raw_f)
+    out_s2d = s2d.apply({"params": new_params}, x_s2d_f)
+    np.testing.assert_allclose(np.asarray(out_s2d), np.asarray(out_direct),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_s2d_config_network_runs():
+    """A flagship-style config with obs_space_to_depth: the network consumes
+    stored_obs_shape observations end-to-end."""
+    cfg = make_test_config(obs_shape=(84, 84, 1), torso="nature",
+                           hidden_dim=32, obs_space_to_depth=True)
+    assert cfg.stored_obs_shape == (21, 21, 16)
+    cfg, net, params = build(cfg)
+    rng = np.random.default_rng(2)
+    obs, la, lr, hidden = random_inputs(cfg, rng, B=2, T=3)
+    assert obs.shape == (2, 3, 21, 21, 16)
+    q, _ = net.apply(params, obs, la, lr, hidden, method=R2D2Network.unroll)
+    assert q.shape == (2, 3, A)
+    assert np.isfinite(np.asarray(q)).all()
