@@ -122,6 +122,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     pb = sub.add_parser("bench", help="single-chip learner throughput")
     pb.add_argument("--steps", type=int, default=100)
 
+    ps = sub.add_parser("sweep",
+                        help="train+eval a game ladder (Atari-57 default)")
+    _add_common(ps)
+    ps.add_argument("--games", default=None,
+                    help="comma-separated game list (default: Atari-57)")
+    ps.add_argument("--out-dir", required=True,
+                    help="root for per-game checkpoints + sweep.json")
+    ps.add_argument("--episodes", type=int, default=None)
+    ps.add_argument("--max-wall-seconds-per-game", type=float, default=None)
+    ps.add_argument("--mesh", action="store_true")
+    ps.add_argument("--quiet", action="store_true")
+
     args = parser.parse_args(argv)
 
     if args.cmd == "bench":
@@ -156,6 +168,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         metrics = fn(cfg, **kwargs)
         print(json.dumps({k: v for k, v in metrics.items()
                           if isinstance(v, (int, float, str))}))
+        return 0
+
+    if args.cmd == "sweep":
+        from r2d2_tpu.sweep import ATARI_57, run_sweep
+
+        games = (args.games.split(",") if args.games else ATARI_57)
+        summary = run_sweep(
+            games, cfg, args.out_dir, eval_episodes=args.episodes,
+            max_wall_seconds_per_game=args.max_wall_seconds_per_game,
+            use_mesh=args.mesh, verbose=not args.quiet)
+        print(json.dumps({g: s["final_reward"] for g, s in summary.items()}))
         return 0
 
     if args.cmd == "eval":

@@ -1,0 +1,139 @@
+"""Race-safety stress tests (SURVEY.md §5.2): the three data planes
+(block ingest / batch sampling / priority feedback) hammering one
+ReplayBuffer concurrently, and concurrent ParamStore publish/get.
+
+The reference tolerates torn weight reads and serialises the buffer with
+one lock (worker.py:65); here the invariants under contention are
+asserted, not assumed.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.replay.block import LocalBuffer
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.utils.store import ParamStore
+
+
+def _make_block(cfg, action_dim, rng, steps=None):
+    """Drive a LocalBuffer through a short fake episode to a real Block."""
+    env = FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=action_dim,
+                       seed=int(rng.integers(1 << 31)))
+    lb = LocalBuffer(cfg, action_dim)
+    obs, _ = env.reset()
+    lb.reset(obs)
+    steps = steps or cfg.block_length
+    for _ in range(steps):
+        a = int(rng.integers(action_dim))
+        obs, r, term, trunc, _ = env.step(a)
+        q = rng.random(action_dim).astype(np.float32)
+        hidden = np.zeros((2, cfg.lstm_layers, cfg.hidden_dim), np.float32)
+        lb.add(a, float(r), obs, q, hidden)
+        if term or trunc or len(lb) == cfg.block_length:
+            return lb.finish(None if (term or trunc) else q)
+    return lb.finish(rng.random(action_dim).astype(np.float32))
+
+
+def test_concurrent_add_sample_update_priorities():
+    cfg = make_test_config(buffer_capacity=320, learning_starts=32)
+    A = 4
+    rng = np.random.default_rng(0)
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1))
+
+    # pre-fill past readiness
+    while not buf.ready:
+        buf.add(*_make_block(cfg, A, rng))
+
+    errors = []
+    stop = threading.Event()
+
+    def guard(fn):
+        def run():
+            local = np.random.default_rng(threading.get_ident() % (1 << 31))
+            try:
+                while not stop.is_set():
+                    fn(local)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+        return run
+
+    def add_plane(local):
+        buf.add(*_make_block(cfg, A, np.random.default_rng(
+            int(local.integers(1 << 31)))))
+
+    sampled = []
+
+    def sample_plane(local):
+        batch = buf.sample_batch()
+        assert batch["obs"].shape[0] == cfg.batch_size
+        assert (batch["learning"] >= 1).all()
+        sampled.append((batch["idxes"], batch["block_ptr"]))
+
+    def update_plane(local):
+        if not sampled:
+            return
+        idxes, ptr = sampled.pop()
+        prios = local.random(len(idxes)).astype(np.float32) + 1e-3
+        buf.update_priorities(idxes, prios, ptr, float(local.random()))
+
+    threads = [threading.Thread(target=guard(f), daemon=True)
+               for f in (add_plane, add_plane, sample_plane, update_plane)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(3.0)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+
+    assert not errors, errors[:1]
+    # buffer invariants survived the contention
+    s = buf.stats()
+    assert 0 < s["size"] <= cfg.buffer_capacity
+    batch = buf.sample_batch()
+    assert np.isfinite(batch["is_weights"]).all()
+    assert (batch["is_weights"] > 0).all()
+
+
+def test_paramstore_concurrent_publish_get_versions_monotonic():
+    store = ParamStore()
+    store.publish({"w": np.zeros(4)})
+    errors = []
+    stop = threading.Event()
+
+    def publisher():
+        v = 0
+        try:
+            while not stop.is_set():
+                v += 1
+                store.publish({"w": np.full(4, float(v))})
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        last = -1
+        try:
+            while not stop.is_set():
+                version, params = store.get()
+                assert version >= last, "version went backwards"
+                # snapshot consistency: all entries carry one value
+                assert len(set(np.asarray(params["w"]).tolist())) == 1
+                last = version
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=publisher, daemon=True)] + [
+        threading.Thread(target=reader, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(3.0)
+    assert not errors, errors[:1]

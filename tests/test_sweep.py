@@ -1,0 +1,43 @@
+"""Sweep orchestration (sweep.py): per-game isolation, resume, summary."""
+import json
+import os
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.sweep import ATARI_57, run_sweep
+
+
+def env_factory(cfg, seed):
+    return FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=4,
+                        seed=seed)
+
+
+def test_atari57_list_is_57_games():
+    assert len(ATARI_57) == 57
+    assert len(set(ATARI_57)) == 57
+
+
+def test_sweep_two_games_and_resume(tmp_path):
+    cfg = make_test_config(training_steps=6, save_interval=3,
+                           eval_episodes=2, max_episode_steps=12)
+    out = str(tmp_path / "sweep")
+    games = ["GameA", "GameB"]
+
+    summary = run_sweep(games, cfg, out, env_factory=env_factory,
+                        eval_episodes=1, verbose=False)
+    assert set(summary) == {"GameA", "GameB"}
+    for g in games:
+        assert os.path.isdir(os.path.join(out, g))
+        assert summary[g]["num_updates"] >= 6
+        assert summary[g]["curve"], "evaluator produced no curve"
+        assert summary[g]["final_reward"] is not None
+    with open(os.path.join(out, "sweep.json")) as f:
+        assert set(json.load(f)) == {"GameA", "GameB"}
+
+    # resume: completed games must be skipped (train_fn must not run)
+    def exploding_train(*a, **k):
+        raise AssertionError("train_fn called for a completed game")
+
+    summary2 = run_sweep(games, cfg, out, env_factory=env_factory,
+                         train_fn=exploding_train, verbose=False)
+    assert summary2 == summary
