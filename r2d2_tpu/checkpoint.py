@@ -29,7 +29,14 @@ class Checkpointer:
     def __init__(self, directory: str):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
-        self._ckptr = ocp.PyTreeCheckpointer()
+        # Explicit Checkpointer+handler composition instead of the
+        # deprecated ``PyTreeCheckpointer`` shortcut.  NOT
+        # ``StandardCheckpointer``: its array-metadata store is broken in
+        # this image (orbax 0.11.32 — any ``StandardCheckpointer().save``
+        # dies with "cannot schedule new futures after shutdown" inside
+        # ``array_metadata_store.read``; the PyTree handler path does not
+        # touch that store and works).
+        self._ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")

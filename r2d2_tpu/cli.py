@@ -156,7 +156,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.distributed:
             from r2d2_tpu.parallel.distributed import init_distributed
 
-            info = init_distributed()
+            # auto=True: on a pod with no JAX_COORDINATOR_ADDRESS etc. set,
+            # autodetect via the TPU metadata server (or raise) instead of
+            # silently degrading to N independent single-host runs
+            info = init_distributed(auto=True)
             print(json.dumps(dict(distributed=info)), flush=True)
         fn = train_sync if args.sync else train
         kwargs: Dict[str, Any] = dict(
@@ -187,9 +190,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from r2d2_tpu.envs import create_env
         from r2d2_tpu.evaluate import evaluate_sweep
 
+        # noop_start=True matches the reference eval protocol
+        # (/root/reference/test.py:16): random 1-30 no-ops diversify eval
+        # start states exactly as during training
         curve = evaluate_sweep(
             cfg, args.ckpt_dir,
-            env_factory=lambda c, seed: create_env(c, noop_start=False,
+            env_factory=lambda c, seed: create_env(c, noop_start=True,
                                                    seed=seed),
             episodes=args.episodes, out_json=args.out_json,
             out_plot=args.plot)

@@ -183,12 +183,16 @@ class Learner:
         if jax.process_count() > 1:
             from r2d2_tpu.parallel.distributed import sync_counter
 
-            def should_stop() -> bool:
-                local = bool(stop()) if stop is not None else False
-                return sync_counter(int(local), reduce="max") > 0
+            def any_host(flag: bool) -> bool:
+                """True iff the condition holds on any host (collective —
+                every host must call it once per loop iteration)."""
+                return sync_counter(int(flag), reduce="max") > 0
         else:
-            def should_stop() -> bool:
-                return stop is not None and stop()
+            def any_host(flag: bool) -> bool:
+                return flag
+
+        def should_stop() -> bool:
+            return any_host(bool(stop()) if stop is not None else False)
 
         losses = []
         try:
@@ -197,7 +201,12 @@ class Learner:
                     break
                 with tracer.span("learner.batch_wait"):
                     item = next_item()
-                if item is None:
+                # batch exhaustion is also a host-local condition (the
+                # host-local stop() can fire between the synced
+                # should_stop() and the queue read) — sync it too, or one
+                # host breaks out while its peers block in the collective
+                # step / the _save allgather
+                if any_host(item is None):
                     break
                 dev_batch, host = item
                 with tracer.span("learner.step_dispatch"):

@@ -26,6 +26,50 @@ from r2d2_tpu.replay.block import Block
 from r2d2_tpu.replay.sum_tree import SumTree
 
 
+def _ring_spec(cfg: Config, action_dim: int):
+    """(name, shape, dtype) of every preallocated ring array — the single
+    source of truth for both the allocation loop and the RAM guard."""
+    NB, K, MS = cfg.num_blocks, cfg.seqs_per_block, cfg.max_block_steps
+    BL, layers, H = cfg.block_length, cfg.lstm_layers, cfg.hidden_dim
+    return (
+        ("obs", (NB, MS, *cfg.stored_obs_shape), np.uint8),
+        ("last_action", (NB, MS, action_dim), bool),
+        ("last_reward", (NB, MS), np.float32),
+        ("action", (NB, BL), np.uint8),
+        ("n_step_reward", (NB, BL), np.float32),
+        ("n_step_gamma", (NB, BL), np.float32),
+        ("hidden", (NB, K, 2, layers, H), np.float32),
+        ("burn_in_steps", (NB, K), np.uint8),
+        ("learning_steps", (NB, K), np.uint8),
+        ("forward_steps", (NB, K), np.uint8),
+        ("first_burn_in", (NB,), np.int64),
+        ("block_learning_total", (NB,), np.int64),
+    )
+
+
+def ring_bytes(cfg: Config, action_dim: int) -> int:
+    """Total bytes the preallocated ring arrays will occupy.
+
+    Dominated by ``obs``: at flagship defaults (5,000 blocks × 441 steps ×
+    84·84 space-to-depth bytes) the obs ring alone is ~15.5 GB, allocated
+    eagerly in ``ReplayBuffer.__init__`` — same transition count as the
+    reference's 2M-transition buffer (config.py:16) but contiguous instead
+    of lazily-held ragged blocks."""
+    return sum(int(np.prod(shape)) * np.dtype(dtype).itemsize
+               for _, shape, dtype in _ring_spec(cfg, action_dim))
+
+
+def _available_host_bytes() -> Optional[int]:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:  # non-Linux host: skip the guard
+        pass
+    return None
+
+
 class ReplayBuffer:
     """Synchronous core. Thread-safe via one lock; process/queue plumbing
     lives in :mod:`r2d2_tpu.train` so this class stays directly testable."""
@@ -34,21 +78,24 @@ class ReplayBuffer:
                  rng: Optional[np.random.Generator] = None):
         self.cfg = cfg
         self.action_dim = action_dim
-        NB, K, MS = cfg.num_blocks, cfg.seqs_per_block, cfg.max_block_steps
-        BL, layers, H = cfg.block_length, cfg.lstm_layers, cfg.hidden_dim
 
-        self.obs = np.zeros((NB, MS, *cfg.stored_obs_shape), np.uint8)
-        self.last_action = np.zeros((NB, MS, action_dim), bool)
-        self.last_reward = np.zeros((NB, MS), np.float32)
-        self.action = np.zeros((NB, BL), np.uint8)
-        self.n_step_reward = np.zeros((NB, BL), np.float32)
-        self.n_step_gamma = np.zeros((NB, BL), np.float32)
-        self.hidden = np.zeros((NB, K, 2, layers, H), np.float32)
-        self.burn_in_steps = np.zeros((NB, K), np.uint8)
-        self.learning_steps = np.zeros((NB, K), np.uint8)
-        self.forward_steps = np.zeros((NB, K), np.uint8)
-        self.first_burn_in = np.zeros(NB, np.int64)
-        self.block_learning_total = np.zeros(NB, np.int64)
+        # Fail fast with an actionable message instead of letting the
+        # allocator OOM partway through the allocation loop (or, worse,
+        # later as the lazily-committed pages fill).  Cap at 90% of
+        # MemAvailable: the model, staged batches, and XLA host buffers
+        # need their own headroom.
+        need = ring_bytes(cfg, action_dim)
+        avail = _available_host_bytes()
+        if avail is not None and need > 0.9 * avail:
+            raise MemoryError(
+                f"replay ring needs {need / 1e9:.1f} GB but only "
+                f"{avail / 1e9:.1f} GB of host memory is available "
+                "(guard requires 10% headroom) — reduce buffer_capacity / "
+                "block_length / obs size (flagship defaults need ~16 GB; "
+                "see README)")
+
+        for name, shape, dtype in _ring_spec(cfg, action_dim):
+            setattr(self, name, np.zeros(shape, dtype))
 
         self.tree = SumTree(cfg.num_sequences, cfg.prio_exponent,
                             cfg.importance_sampling_exponent, rng=rng)

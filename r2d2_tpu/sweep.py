@@ -42,7 +42,9 @@ def run_sweep(games: List[str], base_cfg: Config, out_dir: str,
 
     Layout: ``out_dir/<game>/`` holds that game's checkpoints;
     ``out_dir/sweep.json`` accumulates per-game results as each finishes.
-    A game whose summary entry already exists is skipped (resume).
+    A game whose summary entry shows ``num_updates >= training_steps`` is
+    skipped; a partially-trained game (e.g. stopped by
+    ``max_wall_seconds_per_game``) re-enters training from its checkpoint.
     """
     from r2d2_tpu.envs import create_env
     from r2d2_tpu.evaluate import evaluate_sweep
@@ -59,7 +61,13 @@ def run_sweep(games: List[str], base_cfg: Config, out_dir: str,
             summary = json.load(f)
 
     for game in games:
-        if game in summary:
+        # Skip only games that actually reached the training target: a game
+        # cut short by max_wall_seconds_per_game records its partial
+        # num_updates and re-enters training (resume=True) on the next
+        # sweep invocation — time-sliced sweeps keep making progress.
+        prior = summary.get(game)
+        if (prior is not None
+                and prior.get("num_updates", 0) >= base_cfg.training_steps):
             if verbose:
                 print(f"[sweep] {game}: already done, skipping", flush=True)
             continue

@@ -61,3 +61,25 @@ def test_cli_train_then_eval_round_trip(tmp_path, capsys):
     assert curve and {"step", "env_frames", "minutes", "mean_reward"} <= set(
         curve[-1])
     assert curve[-1]["step"] == 2
+
+
+def test_cli_eval_env_uses_noop_start(tmp_path, monkeypatch):
+    """Eval protocol parity with the reference (test.py:16): eval envs must
+    randomize start states via noop starts, same as training envs."""
+    ckpt = str(tmp_path / "ckpt")
+    main(["train", "--preset", "test", "--game", "Fake", "--sync",
+          "--training-steps", "1", "--ckpt-dir", ckpt])
+
+    import r2d2_tpu.envs as envs_pkg
+
+    seen = []
+    real_create = envs_pkg.create_env
+
+    def spy(cfg, noop_start=True, seed=None, **kw):
+        seen.append(noop_start)
+        return real_create(cfg, noop_start=noop_start, seed=seed, **kw)
+
+    monkeypatch.setattr(envs_pkg, "create_env", spy)
+    main(["eval", "--preset", "test", "--game", "Fake", "--ckpt-dir", ckpt,
+          "--episodes", "1"])
+    assert seen and all(seen), "eval env built without noop_start=True"

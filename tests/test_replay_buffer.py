@@ -278,3 +278,25 @@ def test_cross_block_burn_in_carryover_alignment():
     np.testing.assert_array_equal(
         blk2.obs[:cfg.burn_in_steps + 1],
         blk1.obs[-(cfg.burn_in_steps + 1):])
+
+
+def test_ring_bytes_matches_actual_allocation():
+    from r2d2_tpu.replay.replay_buffer import _ring_spec, ring_bytes
+
+    cfg = make_cfg()
+    buf = ReplayBuffer(cfg, action_dim=4)
+    actual = sum(getattr(buf, name).nbytes
+                 for name, _, _ in _ring_spec(cfg, 4))
+    assert ring_bytes(cfg, 4) == actual
+    # every spec'd array exists with the spec'd shape/dtype
+    for name, shape, dtype in _ring_spec(cfg, 4):
+        arr = getattr(buf, name)
+        assert arr.shape == shape and arr.dtype == np.dtype(dtype)
+
+
+def test_ram_guard_raises_before_allocating(monkeypatch):
+    import r2d2_tpu.replay.replay_buffer as rb
+
+    monkeypatch.setattr(rb, "_available_host_bytes", lambda: 1024)
+    with pytest.raises(MemoryError, match="replay ring needs"):
+        ReplayBuffer(make_cfg(), action_dim=4)

@@ -41,3 +41,38 @@ def test_sweep_two_games_and_resume(tmp_path):
     summary2 = run_sweep(games, cfg, out, env_factory=env_factory,
                          train_fn=exploding_train, verbose=False)
     assert summary2 == summary
+
+
+def test_sweep_reenters_partially_trained_game(tmp_path):
+    """A game cut short (e.g. by max_wall_seconds_per_game) records its
+    partial num_updates and must re-enter training on the next sweep run
+    instead of being skipped on mere key presence."""
+    cfg = make_test_config(training_steps=6, save_interval=3,
+                           eval_episodes=2, max_episode_steps=12)
+    out = str(tmp_path / "sweep")
+    os.makedirs(out)
+    partial = dict(num_updates=2, env_steps=100, minutes=0.1,
+                   mean_loss=1.0, curve=[], final_reward=None)
+    with open(os.path.join(out, "sweep.json"), "w") as f:
+        json.dump({"GameA": partial}, f)
+
+    summary = run_sweep(["GameA"], cfg, out, env_factory=env_factory,
+                        eval_episodes=1, verbose=False)
+    assert summary["GameA"]["num_updates"] >= cfg.training_steps
+
+    # legacy entries without num_updates are treated as incomplete too
+    with open(os.path.join(out, "sweep.json")) as f:
+        data = json.load(f)
+    del data["GameA"]["num_updates"]
+    with open(os.path.join(out, "sweep.json"), "w") as f:
+        json.dump(data, f)
+    calls = []
+
+    def counting_train(*a, **k):
+        calls.append(1)
+        from r2d2_tpu.train import train
+        return train(*a, **k)
+
+    run_sweep(["GameA"], cfg, out, env_factory=env_factory,
+              train_fn=counting_train, eval_episodes=1, verbose=False)
+    assert calls, "legacy summary entry was skipped instead of re-entered"
