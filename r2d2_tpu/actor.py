@@ -18,10 +18,21 @@ The bootstrap Q at a block boundary (worker.py:550-554 runs a *second*
 forward) is obtained for free here: a boundary finish is deferred one
 iteration, and the next iteration's batched Q at the new state is used —
 one forward per env step total.
+
+Env stepping can be parallelised across a thread pool (``env_workers``):
+each worker owns a contiguous shard of lanes, matching the genuine
+CPU-parallelism of the reference's N actor *processes* (train.py:30-34).
+ALE releases the GIL inside ``step``, so threads scale for real Atari;
+every lane's state (env, LocalBuffer, batched-array row ``i``) is touched
+by exactly one worker per iteration, and the block sink is lock-protected
+by the replay buffer, so no extra synchronisation is needed.  Block arrival
+order at the sink becomes nondeterministic across lanes — use
+``env_workers=0`` (serial, the default) where determinism matters.
 """
 from __future__ import annotations
 
 import dataclasses
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -89,7 +100,8 @@ class VectorActor:
 
     def __init__(self, cfg: Config, envs: Sequence[Any],
                  epsilons: Sequence[float], act_fn, param_store: ParamStore,
-                 sink: BlockSink, rng: Optional[np.random.Generator] = None):
+                 sink: BlockSink, rng: Optional[np.random.Generator] = None,
+                 env_workers: Optional[int] = None):
         assert len(envs) == len(epsilons)
         self.cfg = cfg
         self.envs = list(envs)
@@ -100,6 +112,17 @@ class VectorActor:
         self.rng = rng or np.random.default_rng(cfg.seed)
 
         self.N = len(envs)
+        if env_workers is None:
+            env_workers = cfg.env_workers
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._shards: List[range] = [range(self.N)]
+        if env_workers > 1 and self.N > 1:
+            w = min(env_workers, self.N)
+            bounds = np.linspace(0, self.N, w + 1).astype(int)
+            self._shards = [range(bounds[j], bounds[j + 1])
+                            for j in range(w) if bounds[j] < bounds[j + 1]]
+            self._pool = ThreadPoolExecutor(max_workers=len(self._shards),
+                                            thread_name_prefix="env")
         self.action_dim = envs[0].action_space.n
         self.buffers = [LocalBuffer(cfg, self.action_dim) for _ in envs]
         self.episode_steps = np.zeros(self.N, np.int64)
@@ -133,6 +156,43 @@ class VectorActor:
             self._params = params
             self._param_version = version
 
+    def _step_lane(self, i: int, a: int, q_i: np.ndarray,
+                   new_hidden_i: np.ndarray) -> bool:
+        """Advance one lane by one env step (reference actor body,
+        worker.py:537-554).  Returns True when the lane hit the
+        episode-step cap and needs the batched bootstrap pass."""
+        cfg = self.cfg
+        obs, reward, terminated, truncated, _ = self.envs[i].step(a)
+        done = bool(terminated or truncated)
+        self.obs[i] = np.asarray(obs, np.uint8)
+        self.last_action[i] = 0.0
+        self.last_action[i, a] = 1.0
+        self.last_reward[i] = reward
+        self.hidden[i] = new_hidden_i
+        self.episode_steps[i] += 1
+
+        self.buffers[i].add(a, float(reward), self.obs[i], q_i, new_hidden_i)
+
+        if done:
+            self.sink(*self.buffers[i].finish(None))
+            self._reset_lane(i)
+        elif self.episode_steps[i] >= cfg.max_episode_steps:
+            return True
+        elif len(self.buffers[i]) == cfg.block_length:
+            self.finish_pending[i] = True
+        return False
+
+    def _step_shard(self, lanes: range, actions: np.ndarray, q: np.ndarray,
+                    new_hidden: np.ndarray) -> List[int]:
+        return [i for i in lanes
+                if self._step_lane(i, int(actions[i]), q[i], new_hidden[i])]
+
+    def close(self) -> None:
+        """Shut down the env-worker pool (no-op for serial actors)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
     def run(self, max_steps: int, stop: Optional[Callable[[], bool]] = None
             ) -> None:
         """Run ``max_steps`` lockstep iterations (= per-actor env steps)."""
@@ -161,28 +221,16 @@ class VectorActor:
                                self.rng.integers(self.action_dim, size=self.N),
                                q.argmax(axis=1)).astype(np.int64)
 
-            capped: List[int] = []
-            for i, env in enumerate(self.envs):
-                a = int(actions[i])
-                obs, reward, terminated, truncated, _ = env.step(a)
-                done = bool(terminated or truncated)
-                self.obs[i] = np.asarray(obs, np.uint8)
-                self.last_action[i] = 0.0
-                self.last_action[i, a] = 1.0
-                self.last_reward[i] = reward
-                self.hidden[i] = new_hidden[i]
-                self.episode_steps[i] += 1
-
-                self.buffers[i].add(a, float(reward), self.obs[i], q[i],
-                                    new_hidden[i])
-
-                if done:
-                    self.sink(*self.buffers[i].finish(None))
-                    self._reset_lane(i)
-                elif self.episode_steps[i] >= cfg.max_episode_steps:
-                    capped.append(i)
-                elif len(self.buffers[i]) == cfg.block_length:
-                    self.finish_pending[i] = True
+            if self._pool is None:
+                capped = self._step_shard(self._shards[0], actions, q,
+                                          new_hidden)
+            else:
+                futures = [self._pool.submit(self._step_shard, shard,
+                                             actions, q, new_hidden)
+                           for shard in self._shards]
+                # sorted: shard completion order is nondeterministic, but
+                # the capped bootstrap pass below should not be
+                capped = sorted(i for f in futures for i in f.result())
 
             if capped:
                 # episode-step cap (rare): the bootstrap must be Q at the

@@ -1,16 +1,27 @@
-"""Single-chip learner throughput benchmark.
+"""Single-chip benchmark: learner step, actor plane, and the full system.
 
-Measures the jitted R2D2 train step on the flagship config (Nature torso,
-LSTM-512, batch 64, T=85 — reference scale knobs, config.py:7,27-33) on the
-default JAX platform (the real TPU chip when run by the driver).
+Three measurements on the default JAX platform (the real TPU chip when run
+by the driver):
 
-Prints ONE JSON line:
-  {"metric": "learner_env_frames_per_sec", "value": N, "unit": "frames/s",
-   "vs_baseline": N / 50000}
+1. **Learner micro-bench** — the jitted R2D2 train step on the flagship
+   config (Nature torso, LSTM-512, batch 64, T=85 — reference scale knobs,
+   config.py:7,27-33) with a pre-staged device batch.  This is the
+   compute ceiling.  XLA's compiled-module cost analysis grounds it in
+   hardware terms (``achieved_tflops``, ``mfu``).
+2. **Actor-plane bench** — a 64-lane VectorActor (pong preset scale,
+   BASELINE configs[1]) stepping fake envs with batched TPU inference;
+   must sustain at least the learner's env-frame consumption rate to not
+   starve it (the reference gets this from N actor processes,
+   train.py:30-34).
+3. **System bench** — the full threaded fabric (``train.train``: actors →
+   replay → prioritized sampling → H2D prefetch → learner, priority
+   feedback) on fake envs for a fixed wall budget; reports steady-state
+   ``updates/s × batch × learning_steps`` and the busiest tracer spans so
+   the bottleneck is named, not guessed.
 
-learner env-frames/s = batch * learning_steps * steps/s — the rate at which
-the learner consumes environment frames, measured against the BASELINE.md
-north star of >= 50,000 frames/s/chip.
+Prints ONE JSON line; the headline metric stays
+``learner_env_frames_per_sec`` (vs the 50k frames/s/chip north star),
+with the system/actor/MFU numbers as additional fields.
 """
 from __future__ import annotations
 
@@ -22,8 +33,27 @@ import numpy as np
 
 from r2d2_tpu.utils.batch import synthetic_batch as make_batch
 
+NORTH_STAR_FPS = 50_000.0
 
-def main(steps: int = 100, warmup: int = 5) -> None:
+# bf16 peak TFLOPS by device_kind prefix (public spec sheets); used for MFU.
+_PEAK_TFLOPS = (
+    ("TPU v5 lite", 197.0),   # v5e
+    ("TPU v5p", 459.0),
+    ("TPU v4", 275.0),
+    ("TPU v6", 918.0),        # Trillium
+)
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in _PEAK_TFLOPS:
+        if kind.startswith(prefix):
+            return peak
+    return 0.0
+
+
+def _learner_micro_bench(steps: int, warmup: int):
+    """(frames/s, steps/s, flops_per_step_or_0) for the flagship step."""
     import jax
 
     from r2d2_tpu.config import Config
@@ -40,6 +70,18 @@ def main(steps: int = 100, warmup: int = 5) -> None:
     rng = np.random.default_rng(0)
     batch = {k: jax.device_put(v) for k, v in make_batch(cfg, action_dim,
                                                          rng).items()}
+
+    # XLA's own FLOP count for the compiled module — grounded, not hand
+    # derived.  Unavailable on some plugin backends → 0 (fields omitted).
+    flops = 0.0
+    try:
+        compiled = step_fn.lower(state, batch).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float((cost or {}).get("flops", 0.0))
+    except Exception:
+        pass
 
     # synchronize via an actual host transfer: on the tunneled axon TPU
     # platform block_until_ready does not reliably block, so the fence is a
@@ -59,15 +101,106 @@ def main(steps: int = 100, warmup: int = 5) -> None:
 
     steps_per_sec = steps / dt
     frames_per_sec = cfg.batch_size * cfg.learning_steps * steps_per_sec
-    baseline = 50_000.0
-    print(json.dumps({
+    return frames_per_sec, steps_per_sec, flops
+
+
+def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64):
+    """env-frames/s of a pong-scale lockstep fleet on fake envs."""
+    import jax
+
+    from r2d2_tpu.actor import VectorActor, make_act_fn
+    from r2d2_tpu.config import pong_config
+    from r2d2_tpu.envs.fake import FakeAtariEnv
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.utils.math import epsilon_ladder
+    from r2d2_tpu.utils.store import ParamStore
+
+    cfg = pong_config(game_name="Fake", num_actors=num_lanes)
+    net = create_network(cfg, 4)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    store = ParamStore(params)
+    act_fn = make_act_fn(cfg, net)
+    envs = [FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=4,
+                         seed=i, episode_len=500) for i in range(num_lanes)]
+    eps = [epsilon_ladder(i, num_lanes) for i in range(num_lanes)]
+    sunk = []
+    actor = VectorActor(cfg, envs, eps, act_fn, store,
+                        sink=lambda b, p, r: sunk.append(1),
+                        rng=np.random.default_rng(1))
+    actor.run(max_steps=20)  # warmup: compile act fn, prime pools
+    t0 = time.perf_counter()
+    actor.run(max_steps=iterations)
+    dt = time.perf_counter() - t0
+    actor.close()
+    return num_lanes * iterations / dt
+
+
+def _system_bench(wall_seconds: float):
+    """Steady-state env-frames/s of the full threaded fabric on fake envs.
+
+    Returns (frames/s, top_spans) where top_spans names the busiest tracer
+    stages (the measured bottleneck)."""
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.train import train
+
+    cfg = Config().replace(
+        game_name="Fake",
+        num_actors=64,
+        buffer_capacity=200_000,   # 500-block ring ≈ 1.6 GB host RAM
+        learning_starts=10_000,
+        training_steps=1_000_000_000,  # wall-clock bound, not step bound
+        log_interval=5.0,
+        save_interval=1_000_000_000,
+    )
+    metrics = train(cfg, max_wall_seconds=wall_seconds, verbose=False)
+
+    # steady state: median updates/s over the logged entries after the
+    # buffer reached learning_starts (those report nonzero rates)
+    rates = [e["updates_per_sec"] for e in metrics.get("logs", [])
+             if e["updates_per_sec"] > 0]
+    ups = float(np.median(rates[-6:])) if rates else 0.0
+    frames_per_sec = ups * cfg.batch_size * cfg.learning_steps
+
+    trace = metrics.get("trace", {})
+    spans = sorted(
+        ((name[len("span."):-len(".mean_ms")],
+          trace[name] * trace.get(name.replace(".mean_ms", ".count"), 0))
+         for name in trace if name.endswith(".mean_ms")),
+        key=lambda kv: -kv[1])
+    top_spans = {name: round(total_ms, 1) for name, total_ms in spans[:5]}
+    return frames_per_sec, top_spans, metrics.get("num_updates", 0)
+
+
+def main(steps: int = 100, warmup: int = 5,
+         system_seconds: float = 75.0) -> None:
+    import jax
+
+    dev = jax.devices()[0]
+
+    learner_fps, steps_per_sec, flops = _learner_micro_bench(steps, warmup)
+    actor_fps = _actor_plane_bench()
+    system_fps, top_spans, sys_updates = _system_bench(system_seconds)
+
+    result = {
         "metric": "learner_env_frames_per_sec",
-        "value": round(frames_per_sec, 1),
+        "value": round(learner_fps, 1),
         "unit": "frames/s",
-        "vs_baseline": round(frames_per_sec / baseline, 3),
-    }))
-    print(f"# platform={jax.devices()[0].platform} "
-          f"steps/s={steps_per_sec:.2f} dt={dt:.2f}s steps={steps}",
+        "vs_baseline": round(learner_fps / NORTH_STAR_FPS, 3),
+        "system_env_frames_per_sec": round(system_fps, 1),
+        "system_vs_baseline": round(system_fps / NORTH_STAR_FPS, 3),
+        "actor_env_frames_per_sec": round(actor_fps, 1),
+    }
+    if flops > 0:
+        achieved = flops * steps_per_sec / 1e12
+        result["achieved_tflops"] = round(achieved, 2)
+        peak = _peak_tflops(dev)
+        if peak > 0:
+            result["mfu"] = round(achieved / peak, 4)
+    print(json.dumps(result))
+    print(f"# platform={dev.platform} kind={getattr(dev, 'device_kind', '?')} "
+          f"learner_steps/s={steps_per_sec:.2f} flops/step={flops:.3e} "
+          f"system_updates={sys_updates} "
+          f"busiest_spans_total_ms={json.dumps(top_spans)}",
           file=sys.stderr)
 
 

@@ -80,6 +80,9 @@ class Config:
     pallas_interpret: bool = False    # run pallas kernels interpreted (CPU tests)
     mesh_shape: Tuple[Tuple[str, int], ...] = ()  # e.g. (("dp", 4), ("mp", 2))
     prefetch_batches: int = 4         # reference staging list depth, worker.py:312
+    env_workers: int = 0              # >1: thread-pool env stepping (the
+                                      # reference's N-process parallelism,
+                                      # train.py:30-34); 0/1 = serial
     seed: int = 0
 
     # --- derived ----------------------------------------------------------
@@ -130,6 +133,8 @@ class Config:
             raise ValueError("forward_steps must be >= 1")
         if self.num_actors < 1:
             raise ValueError("num_actors must be >= 1")
+        if self.env_workers < 0:
+            raise ValueError("env_workers must be >= 0")
         if self.torso not in ("nature", "impala", "mlp"):
             raise ValueError(f"unknown torso {self.torso!r}")
         if self.lstm_layers < 1:
@@ -167,14 +172,14 @@ def smoke_config(**kw) -> Config:
 
 def pong_config(**kw) -> Config:
     """configs[1]: Pong, 64 actors."""
-    base = dict(game_name="Pong", num_actors=64)
+    base = dict(game_name="Pong", num_actors=64, env_workers=8)
     base.update(kw)
     return Config(**base)
 
 
 def hard_exploration_config(game: str = "MontezumaRevenge", **kw) -> Config:
     """configs[2]: hard-exploration Atari, 256 actors."""
-    base = dict(game_name=game, num_actors=256)
+    base = dict(game_name=game, num_actors=256, env_workers=16)
     base.update(kw)
     return Config(**base)
 
@@ -182,7 +187,7 @@ def hard_exploration_config(game: str = "MontezumaRevenge", **kw) -> Config:
 def atari57_config(game: str, **kw) -> Config:
     """configs[3]: Atari-57 sweep, 256 actors, seq-len 80 (paper hyperparams)."""
     base = dict(
-        game_name=game, num_actors=256,
+        game_name=game, num_actors=256, env_workers=16,
         burn_in_steps=40, learning_steps=40, forward_steps=5,
     )
     base.update(kw)

@@ -120,8 +120,9 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     Returns metrics incl. the per-update loss curve and episode returns.
     """
     # prefetch would run batch_source (which steps the actor) on a thread,
-    # breaking the deterministic interleaving this function promises
-    cfg = cfg.replace(prefetch_batches=0)
+    # and env workers would make block arrival order racy — both break the
+    # deterministic interleaving this function promises
+    cfg = cfg.replace(prefetch_batches=0, env_workers=0)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     actor: VectorActor = sys["actor"]
     buffer: ReplayBuffer = sys["buffer"]
@@ -294,6 +295,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     finally:
         stop_event.set()
         supervisor.join_all(timeout=5.0)
+        actor.close()
 
     # drain remaining priority feedback so buffer counters are final
     while True:

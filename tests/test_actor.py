@@ -124,3 +124,79 @@ def test_create_env_fake_fallback():
     env_b = create_env(cfg, seed=3)
     obs_b, _ = env_b.reset()
     np.testing.assert_array_equal(obs, obs_b)
+
+
+def _block_key(blk):
+    """Canonical content key for comparing block multisets across runs."""
+    return (blk.obs.tobytes(), blk.action.tobytes(),
+            blk.n_step_reward.tobytes(), blk.hidden.tobytes(),
+            blk.burn_in_steps.tobytes(), blk.learning_steps.tobytes())
+
+
+def test_parallel_env_stepping_matches_serial():
+    """env_workers>1 must produce exactly the serial trajectories: lane
+    state, RNG draws, and block contents are identical; only sink arrival
+    order may differ."""
+    def run(workers):
+        cfg = make_test_config(game_name="Fake")
+        net, params, store, act_fn = build(cfg)
+        envs = [FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=i,
+                             episode_len=13) for i in range(6)]
+        out = []
+        actor = VectorActor(cfg, envs, [0.8, 0.5, 0.3, 0.2, 0.1, 0.05],
+                            act_fn, store,
+                            sink=lambda b, p, r: out.append((b, p, r)),
+                            rng=np.random.default_rng(7),
+                            env_workers=workers)
+        actor.run(max_steps=60)
+        actor.close()
+        return actor, out
+
+    a_ser, out_ser = run(0)
+    a_par, out_par = run(4)
+
+    np.testing.assert_array_equal(a_ser.obs, a_par.obs)
+    np.testing.assert_array_equal(a_ser.hidden, a_par.hidden)
+    np.testing.assert_array_equal(a_ser.episode_steps, a_par.episode_steps)
+    assert len(out_ser) == len(out_par)
+    assert (sorted(_block_key(b) for b, _, _ in out_ser)
+            == sorted(_block_key(b) for b, _, _ in out_par))
+    rewards = lambda out: sorted(r for _, _, r in out if r is not None)
+    assert rewards(out_ser) == rewards(out_par)
+
+
+def test_vector_actor_256_lanes_lifecycle():
+    """Preset-scale fleet (atari57/hard-exploration num_actors=256):
+    resets, block cuts, and the episode cap must all fire correctly with
+    pooled env stepping."""
+    cfg = make_test_config(game_name="Fake", max_episode_steps=11)
+    net, params, store, act_fn = build(cfg)
+    N = 256
+    # mixed episode lengths: some terminate (len 9 < cap), some hit the
+    # 11-step cap (len 50), all cut blocks at block_length=8
+    envs = [FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=i,
+                         episode_len=(9 if i % 2 else 50))
+            for i in range(N)]
+    from r2d2_tpu.utils.math import epsilon_ladder
+    eps = [epsilon_ladder(i, N) for i in range(N)]
+    out = []
+    actor = VectorActor(cfg, envs, eps, act_fn, store,
+                        sink=lambda b, p, r: out.append((b, p, r)),
+                        rng=np.random.default_rng(3), env_workers=8)
+    actor.run(max_steps=30)
+    actor.close()
+
+    assert actor.actor_steps == 30
+    # every lane kept stepping: after 30 steps each lane's episode counter
+    # is within [0, cap]
+    assert (actor.episode_steps >= 0).all()
+    assert (actor.episode_steps <= cfg.max_episode_steps).all()
+    # terminating lanes (odd) produced episode rewards; capped lanes (even)
+    # produced capped blocks with bootstrap (reward None)
+    rewards = [r for _, _, r in out if r is not None]
+    assert len(rewards) >= N // 2  # each odd lane terminated >= once
+    assert len(out) > N  # block cuts + terminals across the fleet
+    for blk, prios, _ in out:
+        k = blk.num_sequences
+        assert blk.forward_steps[k - 1] == 1
+        assert blk.action.shape[0] == blk.learning_steps.sum()
