@@ -77,6 +77,25 @@ class AgentState:
         self.hidden = np.asarray(hidden, np.float32)
 
 
+def _resolve_act_device(spec: str):
+    """Device for actor inference, or None to leave placement alone.
+
+    "auto": the CPU backend when the default backend is an accelerator
+    (params get copied host-side once per refresh; every env step's
+    dispatch + q fetch then stays on-host).  "cpu": force it.  "default":
+    never move — inference shares the learner's device.
+    """
+    if spec == "default":
+        return None
+    try:
+        cpu = jax.devices("cpu")[0]
+    except Exception:  # backend absent/filtered out — leave placement alone
+        return None
+    if spec == "cpu" or jax.devices()[0].platform != "cpu":
+        return cpu
+    return None
+
+
 def make_act_fn(cfg: Config, net: R2D2Network):
     """Jitted batched single-step inference:
     (params, obs (B,*obs) u8, last_action (B,A) f32, last_reward (B,) f32,
@@ -112,6 +131,7 @@ class VectorActor:
         self.rng = rng or np.random.default_rng(cfg.seed)
 
         self.N = len(envs)
+        self._act_device = _resolve_act_device(cfg.act_device)
         if env_workers is None:
             env_workers = cfg.env_workers
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -153,6 +173,15 @@ class VectorActor:
     def _refresh_params(self) -> None:
         version, params = self.param_store.get()
         if params is not None and version != self._param_version:
+            if self._act_device is not None:
+                # actor inference runs on the CPU backend: the reference's
+                # actors hold CPU model copies (worker.py:504-507), and on
+                # an accelerator learner this keeps the per-env-step
+                # dispatch+q-fetch off the device interconnect entirely.
+                # One params transfer per refresh (every
+                # actor_update_interval steps) replaces a round trip per
+                # env step.
+                params = jax.device_put(params, self._act_device)
             self._params = params
             self._param_version = version
 
@@ -188,10 +217,13 @@ class VectorActor:
                 if self._step_lane(i, int(actions[i]), q[i], new_hidden[i])]
 
     def close(self) -> None:
-        """Shut down the env-worker pool (no-op for serial actors)."""
+        """Shut down the env-worker pool (no-op for serial actors).  The
+        actor remains usable afterwards — it falls back to serial stepping
+        over ALL lanes."""
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+            self._shards = [range(self.N)]
 
     def run(self, max_steps: int, stop: Optional[Callable[[], bool]] = None
             ) -> None:

@@ -83,6 +83,13 @@ class Config:
     env_workers: int = 0              # >1: thread-pool env stepping (the
                                       # reference's N-process parallelism,
                                       # train.py:30-34); 0/1 = serial
+    device_replay: bool = False       # replay data lives in HBM; batches
+                                      # are gathered in-graph (device_ring)
+    superstep_k: int = 8              # train steps fused per dispatch when
+                                      # device_replay (learner/step.py)
+    act_device: str = "auto"          # actor inference backend: "auto"
+                                      # (CPU when the learner owns an
+                                      # accelerator), "cpu", or "default"
     seed: int = 0
 
     # --- derived ----------------------------------------------------------
@@ -135,6 +142,10 @@ class Config:
             raise ValueError("num_actors must be >= 1")
         if self.env_workers < 0:
             raise ValueError("env_workers must be >= 0")
+        if self.superstep_k < 1:
+            raise ValueError("superstep_k must be >= 1")
+        if self.act_device not in ("auto", "cpu", "default"):
+            raise ValueError(f"unknown act_device {self.act_device!r}")
         if self.torso not in ("nature", "impala", "mlp"):
             raise ValueError(f"unknown torso {self.torso!r}")
         if self.lstm_layers < 1:
@@ -172,14 +183,16 @@ def smoke_config(**kw) -> Config:
 
 def pong_config(**kw) -> Config:
     """configs[1]: Pong, 64 actors."""
-    base = dict(game_name="Pong", num_actors=64, env_workers=8)
+    base = dict(game_name="Pong", num_actors=64, env_workers=8,
+                device_replay=True, superstep_k=16)
     base.update(kw)
     return Config(**base)
 
 
 def hard_exploration_config(game: str = "MontezumaRevenge", **kw) -> Config:
     """configs[2]: hard-exploration Atari, 256 actors."""
-    base = dict(game_name=game, num_actors=256, env_workers=16)
+    base = dict(game_name=game, num_actors=256, env_workers=16,
+                device_replay=True, superstep_k=16)
     base.update(kw)
     return Config(**base)
 

@@ -255,6 +255,102 @@ class Learner:
             mean_loss=float(np.mean(losses[-100:])) if losses else float("nan"),
         )
 
+    def run_device(self, buffer: Any, ring: Any,
+                   priority_sink: Optional[PrioritySink] = None,
+                   max_steps: Optional[int] = None,
+                   stop: Optional[Callable[[], bool]] = None,
+                   tracer: Optional[Any] = None) -> Dict[str, float]:
+        """Drive training from the device-resident replay ring
+        (replay/device_ring.py): ``superstep_k`` optimizer steps per
+        dispatch, batches gathered in-graph, one small H2D (index bundles)
+        and one small D2H (stacked losses+priorities) per super-step.
+
+        Replaces the queued host staging of :meth:`run` when
+        ``cfg.device_replay`` — batch bytes never cross the host↔device
+        boundary, so throughput is immune to interconnect latency (the
+        reference's `.to(device)` per step, worker.py:330-342, is the cost
+        this removes).  Single-process, single-device; the mesh path keeps
+        host staging.
+
+        The update counter advances by k per dispatch, so the loop may
+        overshoot ``training_steps`` by up to k-1 updates.
+        """
+        cfg = self.cfg
+        assert self.mesh is None, "device_replay drives the un-meshed step"
+        if tracer is None:
+            from r2d2_tpu.utils.trace import Tracer
+            tracer = Tracer()
+        from r2d2_tpu.learner.step import make_super_step
+
+        k = cfg.superstep_k
+        t0 = time.time()
+        updates = self.num_updates
+        target = cfg.training_steps if max_steps is None else updates + max_steps
+
+        # AOT-compile outside the buffer lock: the first dispatch happens
+        # under it (sample_meta couples sampling + dispatch), and tracing a
+        # fresh jit there would stall actor add()s for the whole compile
+        super_fn = make_super_step(cfg, self.net, k)
+        B = cfg.batch_size
+        compiled = super_fn.lower(
+            self.state, ring.snapshot(),
+            np.zeros((k, B, 6), np.int32),
+            np.zeros((k, B), np.float32)).compile()
+
+        losses_hist = []
+        while updates < target:
+            if stop is not None and stop():
+                break
+            if not buffer.ready:
+                time.sleep(0.02)
+                continue
+
+            def dispatch(ints, weights):
+                with tracer.span("learner.step_dispatch"):
+                    return compiled(self.state, ring.snapshot(),
+                                    jnp.asarray(ints), jnp.asarray(weights))
+
+            with tracer.span("learner.sample_meta"):
+                meta = buffer.sample_meta(k, dispatch=dispatch)
+            self.state, losses, priorities = meta["dispatched"]
+
+            with tracer.span("learner.result_sync"):
+                # one D2H round trip for everything the host needs
+                flat = np.asarray(jax.device_get(
+                    jnp.concatenate([losses, priorities.reshape(-1)])))
+            losses_np, prios_np = flat[:k], flat[k:].reshape(k, B)
+            assert np.isfinite(losses_np).all(), (
+                f"non-finite loss in super-step: {losses_np}")
+
+            prev, updates = updates, updates + k
+            self.env_steps = int(meta["env_steps"])
+            if priority_sink is not None:
+                for j in range(k):
+                    priority_sink(meta["idxes"][j], prios_np[j],
+                                  meta["block_ptr"], float(losses_np[j]))
+            losses_hist.extend(losses_np.tolist())
+
+            # cadences fire on interval crossings (updates advances by k)
+            if (self.param_store is not None
+                    and updates // cfg.weight_publish_interval
+                    > prev // cfg.weight_publish_interval):
+                self._publish()
+            if (self.checkpointer is not None
+                    and updates // cfg.save_interval
+                    > prev // cfg.save_interval):
+                self._save(updates, t0)
+
+        if self.checkpointer is not None:
+            self._save(self.num_updates, t0)
+        mins = self.start_minutes + (time.time() - t0) / 60.0
+        return dict(
+            num_updates=self.num_updates,
+            env_steps=self.env_steps,
+            minutes=mins,
+            mean_loss=(float(np.mean(losses_hist[-100:]))
+                       if losses_hist else float("nan")),
+        )
+
     def _save(self, updates: int, t0: float) -> None:
         minutes = self.start_minutes + (time.time() - t0) / 60.0
         if jax.process_count() > 1:

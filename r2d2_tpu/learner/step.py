@@ -177,3 +177,35 @@ def make_train_step(cfg: Config, net: R2D2Network):
 
 def jit_train_step(cfg: Config, net: R2D2Network):
     return jax.jit(make_train_step(cfg, net), donate_argnums=(0,))
+
+
+def make_super_step(cfg: Config, net: R2D2Network, k: int):
+    """``k`` train steps per dispatch, batches gathered in-graph from the
+    device-resident replay ring (replay/device_ring.py).
+
+    This is the latency-immune learner drivetrain: one dispatch + one small
+    H2D (the (k, B, 6) index bundle) + one small D2H (stacked losses and
+    priorities) amortise host↔device round trips over ``k`` optimizer
+    steps, while batch bytes never cross the boundary at all.  The inner
+    step is exactly ``make_train_step`` — target sync and the step counter
+    advance per inner step, so k super-steps ≡ k·1 plain steps.
+
+    Returns ``super_step(state, ring_arrays, ints (k,B,6) i32,
+    is_weights (k,B) f32) -> (state, losses (k,), priorities (k,B))``.
+    """
+    from r2d2_tpu.replay.device_ring import gather_batch
+
+    step = make_train_step(cfg, net)
+
+    def super_step(state: TrainState, arrays, ints, is_weights):
+        def body(st, x):
+            ints_t, w_t = x
+            batch = gather_batch(cfg, arrays, ints_t, w_t)
+            st, loss, priorities = step(st, batch)
+            return st, (loss, priorities)
+
+        state, (losses, priorities) = jax.lax.scan(
+            body, state, (ints, is_weights))
+        return state, losses, priorities
+
+    return jax.jit(super_step, donate_argnums=(0,))

@@ -1,0 +1,155 @@
+"""Device-resident replay ring: replay data lives in HBM, not host RAM.
+
+The reference's data plane moves every training batch across the host↔device
+boundary (worker.py:330-342 `.to(device)` per step).  At flagship shapes that
+is ~40 MB per batch — the dominant system cost on any real interconnect
+(PCIe, and catastrophically so on a tunneled chip).  The TPU-first redesign
+inverts the flow:
+
+- Each experience block crosses H2D **once**, when the actor produces it
+  (~3 MB, at block-production rate — orders of magnitude less traffic than
+  per-batch staging).
+- The ring arrays (same layout as the host ring, replay_buffer.py) live on
+  the device; batch assembly is an in-graph gather executed at HBM
+  bandwidth inside the jitted train step.
+- The host keeps what it is good at: the sum-tree, priorities, ring
+  accounting, and stale-index masking.  Only tiny index/weight arrays cross
+  per batch.
+
+Writes are donated ``dynamic_update_index_in_dim`` updates — the ring is
+updated in place on device, never reallocated.
+
+CONCURRENCY CONTRACT: ``write`` and ``snapshot``+train-step-dispatch must
+be externally serialised (the ReplayBuffer's lock is the coordination
+point — add() writes under it, the learner samples indices and dispatches
+under it).  Two reasons: a ``write`` donates the current handles, so a
+racing dispatch could hand XLA a deleted buffer; and an index bundle
+computed from the host accounting must be dispatched before any later
+write lands, or the on-device gather could read a slot newer than the
+indices describe.  Device-stream ordering guarantees the rest: dispatches
+execute in order, so a bundle dispatched before a write reads pre-write
+data.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.replay.block import Block
+
+# data arrays mirrored on device, (name, per-block shape fn, dtype);
+# the count arrays (burn_in/learning/forward, first_burn_in) stay host-only
+# — they are needed for *index computation*, which is host work.
+_DATA_KEYS = ("obs", "last_action", "last_reward", "action",
+              "n_step_reward", "n_step_gamma", "hidden")
+
+
+def _slot_shapes(cfg: Config, action_dim: int) -> Dict[str, Any]:
+    MS, BL = cfg.max_block_steps, cfg.block_length
+    K, layers, H = cfg.seqs_per_block, cfg.lstm_layers, cfg.hidden_dim
+    return dict(
+        obs=((MS, *cfg.stored_obs_shape), np.uint8),
+        last_action=((MS, action_dim), np.bool_),
+        last_reward=((MS,), np.float32),
+        action=((BL,), np.uint8),
+        n_step_reward=((BL,), np.float32),
+        n_step_gamma=((BL,), np.float32),
+        hidden=((K, 2, layers, H), np.float32),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_slot(arrays: Dict[str, jnp.ndarray],
+                slot: Dict[str, jnp.ndarray], ptr: jnp.ndarray):
+    return {k: jax.lax.dynamic_update_index_in_dim(arrays[k], slot[k], ptr,
+                                                   axis=0)
+            for k in arrays}
+
+
+def gather_batch(cfg: Config, arrays: Dict[str, jnp.ndarray],
+                 ints: jnp.ndarray, is_weights: jnp.ndarray
+                 ) -> Dict[str, jnp.ndarray]:
+    """In-graph batch assembly — the device twin of
+    ``ReplayBuffer.sample_batch`` (replay_buffer.py), same index arithmetic,
+    same clamp invariant (stale/padded bytes can only occupy positions the
+    loss masks out; see the INVARIANT note there).
+
+    ``ints`` is (B, 6) int32: [block_idx, t0, seq_idx, burn_in, learning,
+    forward] computed host-side under the buffer lock.
+    """
+    L, T = cfg.learning_steps, cfg.seq_len
+    block_idx, t0 = ints[:, 0], ints[:, 1]
+    seq_idx = ints[:, 2]
+
+    time_idx = jnp.minimum(t0[:, None] + jnp.arange(T),
+                           cfg.max_block_steps - 1)          # (B, T)
+    bcol = block_idx[:, None]
+    widx = jnp.minimum(seq_idx[:, None] * L + jnp.arange(L),
+                       cfg.block_length - 1)                 # (B, L)
+    return dict(
+        obs=arrays["obs"][bcol, time_idx],
+        last_action=arrays["last_action"][bcol, time_idx].astype(jnp.float32),
+        last_reward=arrays["last_reward"][bcol, time_idx],
+        hidden=arrays["hidden"][block_idx, seq_idx],
+        action=arrays["action"][bcol, widx].astype(jnp.int32),
+        n_step_reward=arrays["n_step_reward"][bcol, widx],
+        n_step_gamma=arrays["n_step_gamma"][bcol, widx],
+        burn_in=ints[:, 3],
+        learning=ints[:, 4],
+        forward=ints[:, 5],
+        is_weights=is_weights,
+    )
+
+
+class DeviceRing:
+    """Owns the device-resident ring arrays and their write path."""
+
+    def __init__(self, cfg: Config, action_dim: int,
+                 device: Optional[Any] = None):
+        self.cfg = cfg
+        self.action_dim = action_dim
+        self._device = device
+        NB = cfg.num_blocks
+        self._slot_shapes = _slot_shapes(cfg, action_dim)
+        self.arrays = {
+            k: self._put(np.zeros((NB, *shape), dtype))
+            for k, (shape, dtype) in self._slot_shapes.items()}
+
+    def _put(self, x):
+        return (jax.device_put(x, self._device) if self._device is not None
+                else jax.device_put(x))
+
+    def nbytes(self) -> int:
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in self.arrays.values())
+
+    def write(self, block: Block, ptr: int) -> None:
+        """Stream one block into ring slot ``ptr`` (H2D once per block;
+        caller holds the coordinating lock — see the module contract).
+
+        Short blocks are zero-padded to the fixed slot shape; the padding
+        occupies exactly the positions the host ring would leave stale,
+        which the sampling clamp invariant already guarantees are
+        loss-masked.
+        """
+        slot = {}
+        for k, (shape, dtype) in self._slot_shapes.items():
+            arr = np.zeros(shape, dtype)
+            src = getattr(block, k)
+            if k == "hidden":
+                arr[:block.num_sequences] = src
+            else:
+                arr[:src.shape[0]] = src
+            slot[k] = self._put(arr)
+        self.arrays = _write_slot(self.arrays, slot,
+                                  jnp.asarray(ptr, jnp.int32))
+
+    def snapshot(self) -> Dict[str, jnp.ndarray]:
+        """Current ring handles, safe to pass to a train-step dispatch
+        (caller holds the coordinating lock — see the module contract)."""
+        return self.arrays

@@ -17,7 +17,7 @@ whole batch cost, which is what lets the host feed a TPU-rate learner.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -26,9 +26,9 @@ from r2d2_tpu.replay.block import Block
 from r2d2_tpu.replay.sum_tree import SumTree
 
 
-def _ring_spec(cfg: Config, action_dim: int):
-    """(name, shape, dtype) of every preallocated ring array — the single
-    source of truth for both the allocation loop and the RAM guard."""
+def _data_spec(cfg: Config, action_dim: int):
+    """(name, shape, dtype) of the bulk experience arrays.  These are the
+    arrays that can live on-device instead (replay/device_ring.py)."""
     NB, K, MS = cfg.num_blocks, cfg.seqs_per_block, cfg.max_block_steps
     BL, layers, H = cfg.block_length, cfg.lstm_layers, cfg.hidden_dim
     return (
@@ -39,12 +39,34 @@ def _ring_spec(cfg: Config, action_dim: int):
         ("n_step_reward", (NB, BL), np.float32),
         ("n_step_gamma", (NB, BL), np.float32),
         ("hidden", (NB, K, 2, layers, H), np.float32),
+    )
+
+
+def _count_spec(cfg: Config):
+    """(name, shape, dtype) of the per-sequence/per-block accounting arrays
+    — always host-side (they drive index computation and sampling)."""
+    NB, K = cfg.num_blocks, cfg.seqs_per_block
+    return (
         ("burn_in_steps", (NB, K), np.uint8),
         ("learning_steps", (NB, K), np.uint8),
         ("forward_steps", (NB, K), np.uint8),
         ("first_burn_in", (NB,), np.int64),
         ("block_learning_total", (NB,), np.int64),
     )
+
+
+def _ring_spec(cfg: Config, action_dim: int):
+    """(name, shape, dtype) of every preallocated host ring array — the
+    single source of truth for both the allocation loop and the RAM
+    guard."""
+    return _data_spec(cfg, action_dim) + _count_spec(cfg)
+
+
+def data_bytes(cfg: Config, action_dim: int) -> int:
+    """Bytes of the bulk experience arrays alone (what a DeviceRing puts
+    in HBM)."""
+    return sum(int(np.prod(shape)) * np.dtype(dtype).itemsize
+               for _, shape, dtype in _data_spec(cfg, action_dim))
 
 
 def ring_bytes(cfg: Config, action_dim: int) -> int:
@@ -75,16 +97,26 @@ class ReplayBuffer:
     lives in :mod:`r2d2_tpu.train` so this class stays directly testable."""
 
     def __init__(self, cfg: Config, action_dim: int,
-                 rng: Optional[np.random.Generator] = None):
+                 rng: Optional[np.random.Generator] = None,
+                 device_ring: Optional[Any] = None):
+        """``device_ring`` (replay/device_ring.DeviceRing): when given, the
+        bulk experience arrays live in HBM — ``add`` streams each block to
+        the device once, ``sample_meta`` yields index bundles for the
+        in-graph gather, and the big host data arrays are NOT allocated
+        (``sample_batch`` then raises)."""
         self.cfg = cfg
         self.action_dim = action_dim
+        self.device_ring = device_ring
 
+        spec = _count_spec(cfg) if device_ring is not None else _ring_spec(
+            cfg, action_dim)
         # Fail fast with an actionable message instead of letting the
         # allocator OOM partway through the allocation loop (or, worse,
         # later as the lazily-committed pages fill).  Cap at 90% of
         # MemAvailable: the model, staged batches, and XLA host buffers
         # need their own headroom.
-        need = ring_bytes(cfg, action_dim)
+        need = sum(int(np.prod(shape)) * np.dtype(dtype).itemsize
+                   for _, shape, dtype in spec)
         avail = _available_host_bytes()
         if avail is not None and need > 0.9 * avail:
             raise MemoryError(
@@ -94,7 +126,7 @@ class ReplayBuffer:
                 "block_length / obs size (flagship defaults need ~16 GB; "
                 "see README)")
 
-        for name, shape, dtype in _ring_spec(cfg, action_dim):
+        for name, shape, dtype in spec:
             setattr(self, name, np.zeros(shape, dtype))
 
         self.tree = SumTree(cfg.num_sequences, cfg.prio_exponent,
@@ -129,16 +161,22 @@ class ReplayBuffer:
 
             self.size -= int(self.block_learning_total[ptr])
 
-            n_obs = block.obs.shape[0]
-            n_steps = block.action.shape[0]
             k = block.num_sequences
-            self.obs[ptr, :n_obs] = block.obs
-            self.last_action[ptr, :n_obs] = block.last_action
-            self.last_reward[ptr, :n_obs] = block.last_reward
-            self.action[ptr, :n_steps] = block.action
-            self.n_step_reward[ptr, :n_steps] = block.n_step_reward
-            self.n_step_gamma[ptr, :n_steps] = block.n_step_gamma
-            self.hidden[ptr, :k] = block.hidden
+            if self.device_ring is not None:
+                # bulk data goes straight to HBM (once per block); the
+                # stream-order/donation contract is upheld because we hold
+                # self.lock, the same lock sample_meta dispatches under
+                self.device_ring.write(block, ptr)
+            else:
+                n_obs = block.obs.shape[0]
+                n_steps = block.action.shape[0]
+                self.obs[ptr, :n_obs] = block.obs
+                self.last_action[ptr, :n_obs] = block.last_action
+                self.last_reward[ptr, :n_obs] = block.last_reward
+                self.action[ptr, :n_steps] = block.action
+                self.n_step_reward[ptr, :n_steps] = block.n_step_reward
+                self.n_step_gamma[ptr, :n_steps] = block.n_step_gamma
+                self.hidden[ptr, :k] = block.hidden
             self.burn_in_steps[ptr] = 0
             self.learning_steps[ptr] = 0
             self.forward_steps[ptr] = 0
@@ -168,6 +206,10 @@ class ReplayBuffer:
         bookkeeping: idxes, block_ptr snapshot, env_steps (worker.py:219-238).
         """
         cfg = self.cfg
+        if self.device_ring is not None:
+            raise RuntimeError(
+                "sample_batch needs host data arrays; this buffer runs "
+                "device_replay — use sample_meta + the in-graph gather")
         B = batch_size or cfg.batch_size
         K, L, T = cfg.seqs_per_block, cfg.learning_steps, cfg.seq_len
         with self.lock:
@@ -223,6 +265,57 @@ class ReplayBuffer:
                 env_steps=self.env_steps,
             )
         return batch
+
+    # ---------------------------------------------------------- sample (meta)
+    def sample_meta(self, k: int, batch_size: Optional[int] = None,
+                    dispatch=None) -> Dict[str, np.ndarray]:
+        """Sample ``k`` index bundles for the in-graph device gather
+        (replay/device_ring.gather_batch) — the index arithmetic of
+        ``sample_batch`` without touching any data array.
+
+        The k bundles are drawn without intermediate priority feedback,
+        mirroring the prefetch depth of the queued host path (the reference
+        stages up to 8+4 batches ahead of the learner, worker.py:300-316).
+
+        ``dispatch``, when given, is called as ``dispatch(ints, weights)``
+        while the buffer lock is still held and its result returned under
+        ``meta["dispatched"]`` — this orders the train-step dispatch before
+        any later ring write (the device_ring concurrency contract).
+
+        Returns ints (k,B,6) i32 · is_weights (k,B) f32 · idxes (k,B) i64 ·
+        block_ptr · env_steps.
+        """
+        cfg = self.cfg
+        B = batch_size or cfg.batch_size
+        K, L = cfg.seqs_per_block, cfg.learning_steps
+        ints = np.empty((k, B, 6), np.int32)
+        weights = np.empty((k, B), np.float32)
+        idxes = np.empty((k, B), np.int64)
+        with self.lock:
+            if self.size == 0:
+                raise RuntimeError(
+                    "sample_meta on an empty buffer; wait for add() (use "
+                    "`ready` to gate on learning_starts)")
+            for j in range(k):
+                idx, w = self.tree.sample(B)
+                block_idx = idx // K
+                seq_idx = idx % K
+                burn_in = self.burn_in_steps[block_idx, seq_idx].astype(
+                    np.int64)
+                start = self.first_burn_in[block_idx] + seq_idx * L
+                ints[j, :, 0] = block_idx
+                ints[j, :, 1] = start - burn_in          # t0, always >= 0
+                ints[j, :, 2] = seq_idx
+                ints[j, :, 3] = burn_in
+                ints[j, :, 4] = self.learning_steps[block_idx, seq_idx]
+                ints[j, :, 5] = self.forward_steps[block_idx, seq_idx]
+                weights[j] = w
+                idxes[j] = idx
+            meta = dict(ints=ints, is_weights=weights, idxes=idxes,
+                        block_ptr=self.block_ptr, env_steps=self.env_steps)
+            if dispatch is not None:
+                meta["dispatched"] = dispatch(ints, weights)
+        return meta
 
     # ------------------------------------------------------- priority update
     def update_priorities(self, idxes: np.ndarray, priorities: np.ndarray,

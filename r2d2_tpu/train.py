@@ -91,8 +91,35 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                       checkpointer=checkpointer,
                       start_env_steps=start_env_steps,
                       start_minutes=start_minutes)
+    ring = None
+    if cfg.device_replay and mesh is None:
+        from r2d2_tpu.replay.device_ring import DeviceRing
+        from r2d2_tpu.replay.replay_buffer import data_bytes
+
+        need, cap = data_bytes(cfg, action_dim), _device_memory_bytes()
+        if cap is None:
+            # backend exposes no memory stats (e.g. the CPU client):
+            # "device" memory IS host memory, so apply the host guard
+            from r2d2_tpu.replay.replay_buffer import _available_host_bytes
+
+            cap = _available_host_bytes()
+        if cap is not None and need > 0.8 * cap:
+            import warnings
+
+            warnings.warn(
+                f"device_replay ring needs {need / 1e9:.1f} GB but the "
+                f"device has {cap / 1e9:.1f} GB; falling back to host "
+                "replay — reduce buffer_capacity to fit", stacklevel=2)
+        else:
+            ring = DeviceRing(cfg, action_dim)
+    elif cfg.device_replay and mesh is not None:
+        import warnings
+
+        warnings.warn("device_replay currently drives the single-device "
+                      "step; using host replay under the mesh", stacklevel=2)
     buffer = ReplayBuffer(cfg, action_dim,
-                          rng=np.random.default_rng(cfg.seed))
+                          rng=np.random.default_rng(cfg.seed),
+                          device_ring=ring)
     buffer.env_steps = start_env_steps
     act_fn = make_act_fn(cfg, net)
     epsilons = [epsilon_ladder(i, cfg.num_actors, cfg.base_eps, cfg.eps_alpha)
@@ -102,7 +129,15 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                         rng=np.random.default_rng(cfg.seed + 7919))
     return dict(envs=envs, action_dim=action_dim, net=net, learner=learner,
                 buffer=buffer, actor=actor, param_store=param_store,
-                checkpointer=checkpointer, host_bs=host_bs)
+                checkpointer=checkpointer, host_bs=host_bs, ring=ring)
+
+
+def _device_memory_bytes():
+    try:
+        stats = jax.devices()[0].memory_stats()
+        return int(stats["bytes_limit"]) if stats else None
+    except Exception:
+        return None
 
 
 # --------------------------------------------------------------------------
@@ -121,8 +156,11 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     """
     # prefetch would run batch_source (which steps the actor) on a thread,
     # and env workers would make block arrival order racy — both break the
-    # deterministic interleaving this function promises
-    cfg = cfg.replace(prefetch_batches=0, env_workers=0)
+    # deterministic interleaving this function promises; device_replay's
+    # k-step dispatch granularity likewise (this path applies priority
+    # feedback after every single update)
+    cfg = cfg.replace(prefetch_batches=0, env_workers=0,
+                      device_replay=False)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     actor: VectorActor = sys["actor"]
     buffer: ReplayBuffer = sys["buffer"]
@@ -267,8 +305,13 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                       f"loss={entry['mean_loss']:.4f}", flush=True)
             last_steps, last_time = s["training_steps"], now
 
-    for name, loop in (("actor", actor_loop), ("sample", sample_loop),
-                       ("priority", priority_loop), ("log", log_loop)):
+    loops = [("actor", actor_loop), ("sample", sample_loop),
+             ("priority", priority_loop), ("log", log_loop)]
+    if sys["ring"] is not None:
+        # device replay: the learner samples index bundles itself (cheap,
+        # coupled to its dispatch) — no host batch-staging thread
+        loops = [(n, f) for n, f in loops if n != "sample"]
+    for name, loop in loops:
         supervisor.start(name, loop)
 
     def batch_source():
@@ -290,8 +333,13 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     try:
         with device_profile(profile_dir):
-            metrics = learner.run(batch_source, priority_sink, stop=stop,
-                                  tracer=tracer)
+            if sys["ring"] is not None:
+                metrics = learner.run_device(buffer, sys["ring"],
+                                             priority_sink, stop=stop,
+                                             tracer=tracer)
+            else:
+                metrics = learner.run(batch_source, priority_sink, stop=stop,
+                                      tracer=tracer)
     finally:
         stop_event.set()
         supervisor.join_all(timeout=5.0)
