@@ -274,9 +274,15 @@ class Learner:
 
         The update counter advances by k per dispatch, so the loop may
         overshoot ``training_steps`` by up to k-1 updates.
+
+        Under a mesh (single process): the ring is mesh-replicated and the
+        super-step is GSPMD-sharded (parallel.mesh.sharded_super_step) —
+        index bundles shard their batch axis over dp, grads psum over ICI.
         """
         cfg = self.cfg
-        assert self.mesh is None, "device_replay drives the un-meshed step"
+        assert jax.process_count() == 1, (
+            "device_replay is per-process; multi-host runs use host "
+            "staging (Learner.run)")
         if tracer is None:
             from r2d2_tpu.utils.trace import Tracer
             tracer = Tracer()
@@ -290,7 +296,13 @@ class Learner:
         # AOT-compile outside the buffer lock: the first dispatch happens
         # under it (sample_meta couples sampling + dispatch), and tracing a
         # fresh jit there would stall actor add()s for the whole compile
-        super_fn = make_super_step(cfg, self.net, k)
+        if self.mesh is not None:
+            from r2d2_tpu.parallel.mesh import sharded_super_step
+
+            super_fn = sharded_super_step(cfg, self.net, self.mesh, k,
+                                          state_template=self.state)
+        else:
+            super_fn = make_super_step(cfg, self.net, k)
         B = cfg.batch_size
         compiled = super_fn.lower(
             self.state, ring.snapshot(),

@@ -179,9 +179,9 @@ def jit_train_step(cfg: Config, net: R2D2Network):
     return jax.jit(make_train_step(cfg, net), donate_argnums=(0,))
 
 
-def make_super_step(cfg: Config, net: R2D2Network, k: int):
-    """``k`` train steps per dispatch, batches gathered in-graph from the
-    device-resident replay ring (replay/device_ring.py).
+def make_super_step_fn(cfg: Config, net: R2D2Network, k: int):
+    """The unjitted ``k``-fused-steps function — batches gathered in-graph
+    from the device-resident replay ring (replay/device_ring.py).
 
     This is the latency-immune learner drivetrain: one dispatch + one small
     H2D (the (k, B, 6) index bundle) + one small D2H (stacked losses and
@@ -190,8 +190,10 @@ def make_super_step(cfg: Config, net: R2D2Network, k: int):
     step is exactly ``make_train_step`` — target sync and the step counter
     advance per inner step, so k super-steps ≡ k·1 plain steps.
 
-    Returns ``super_step(state, ring_arrays, ints (k,B,6) i32,
+    Signature: ``super_step(state, ring_arrays, ints (k,B,6) i32,
     is_weights (k,B) f32) -> (state, losses (k,), priorities (k,B))``.
+    Wrap with :func:`make_super_step` (single device) or
+    ``parallel.mesh.sharded_super_step`` (mesh).
     """
     from r2d2_tpu.replay.device_ring import gather_batch
 
@@ -208,4 +210,8 @@ def make_super_step(cfg: Config, net: R2D2Network, k: int):
             body, state, (ints, is_weights))
         return state, losses, priorities
 
-    return jax.jit(super_step, donate_argnums=(0,))
+    return super_step
+
+
+def make_super_step(cfg: Config, net: R2D2Network, k: int):
+    return jax.jit(make_super_step_fn(cfg, net, k), donate_argnums=(0,))

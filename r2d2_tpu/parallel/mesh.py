@@ -151,18 +151,7 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
     if "mp" in mesh.axis_names and state_template is None:
         raise ValueError("an mp mesh needs state_template to derive "
                          "per-parameter shardings")
-    from r2d2_tpu.models.network import create_network, resolve_lstm_impl
-    if resolve_lstm_impl(cfg) == "pallas":
-        # the fused Pallas LSTM is a single-device program GSPMD cannot
-        # partition; an explicit request is an error, while "auto" falls
-        # back to the scan recurrence (identical params) which compiles to
-        # per-shard XLA under the mesh
-        if cfg.lstm_impl == "pallas":
-            raise ValueError(
-                "lstm_impl='pallas' cannot run under a mesh (GSPMD cannot "
-                "partition the fused kernel); use lstm_impl='auto' or 'scan'")
-        net = create_network(cfg.replace(lstm_impl="scan"), net.action_dim)
-    step = make_train_step(cfg, net)
+    step = make_train_step(cfg, _mesh_net(cfg, net))
     repl = replicated(mesh)
     dp = NamedSharding(mesh, P("dp"))
     st_shard = (state_shardings(mesh, state_template)
@@ -172,6 +161,61 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
         step,
         in_shardings=(st_shard, {k: dp for k in DEVICE_BATCH_KEYS}),
         out_shardings=(st_shard, repl, dp),
+        donate_argnums=(0,),
+    )
+
+
+def _mesh_net(cfg: Config, net: R2D2Network) -> R2D2Network:
+    """The network variant a mesh-compiled step must use (the fused Pallas
+    LSTM is a single-device program GSPMD cannot partition; "auto" falls
+    back to the scan recurrence — identical params — while an explicit
+    request is an error)."""
+    from r2d2_tpu.models.network import create_network, resolve_lstm_impl
+
+    if resolve_lstm_impl(cfg) != "pallas":
+        return net
+    if cfg.lstm_impl == "pallas":
+        raise ValueError(
+            "lstm_impl='pallas' cannot run under a mesh (GSPMD cannot "
+            "partition the fused kernel); use lstm_impl='auto' or 'scan'")
+    return create_network(cfg.replace(lstm_impl="scan"), net.action_dim)
+
+
+def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
+                       state_template: Optional[TrainState] = None):
+    """The device-replay super-step compiled over the mesh.
+
+    Layout: the HBM ring is **replicated** across the mesh (every device
+    holds the full ring — writes broadcast once per block), the index
+    bundles and is_weights shard their batch axis (axis 1) over ``dp``,
+    and the in-graph gather therefore produces a dp-sharded batch with no
+    collectives: each device gathers only its rows from its local ring
+    replica.  Params follow the same rules as :func:`sharded_train_step`,
+    so grad psums ride ICI exactly as in the host-staged path.
+
+    Single-process only (each host's ring holds its own buffer's data, so
+    a multi-host mesh cannot see one coherent replicated ring) — the
+    caller guards.
+    """
+    if cfg.batch_size % mesh.shape["dp"] != 0:
+        raise ValueError(
+            f"batch_size {cfg.batch_size} not divisible by "
+            f"dp={mesh.shape['dp']}")
+    if "mp" in mesh.axis_names and state_template is None:
+        raise ValueError("an mp mesh needs state_template to derive "
+                         "per-parameter shardings")
+    from r2d2_tpu.learner.step import make_super_step_fn
+    from r2d2_tpu.replay.device_ring import ring_sharding
+
+    fn = make_super_step_fn(cfg, _mesh_net(cfg, net), k)
+    repl = replicated(mesh)
+    dp_b = NamedSharding(mesh, P(None, "dp"))
+    st_shard = (state_shardings(mesh, state_template)
+                if state_template is not None else repl)
+    return jax.jit(
+        fn,
+        in_shardings=(st_shard, ring_sharding(mesh), dp_b, dp_b),
+        out_shardings=(st_shard, repl, dp_b),
         donate_argnums=(0,),
     )
 

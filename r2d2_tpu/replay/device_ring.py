@@ -106,14 +106,28 @@ def gather_batch(cfg: Config, arrays: Dict[str, jnp.ndarray],
     )
 
 
+def ring_sharding(mesh) -> Dict[str, Any]:
+    """Replicated-over-the-mesh sharding for every ring array (each device
+    holds the full ring; gathers then need no collectives)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    return {k: rep for k in _DATA_KEYS}
+
+
 class DeviceRing:
-    """Owns the device-resident ring arrays and their write path."""
+    """Owns the device-resident ring arrays and their write path.
+
+    ``placement`` may be a Device (single-chip) or a Sharding — pass
+    ``NamedSharding(mesh, P())`` (see :func:`ring_sharding`) to replicate
+    the ring across a mesh for the sharded super-step.
+    """
 
     def __init__(self, cfg: Config, action_dim: int,
-                 device: Optional[Any] = None):
+                 placement: Optional[Any] = None):
         self.cfg = cfg
         self.action_dim = action_dim
-        self._device = device
+        self._placement = placement
         NB = cfg.num_blocks
         self._slot_shapes = _slot_shapes(cfg, action_dim)
         self.arrays = {
@@ -121,8 +135,8 @@ class DeviceRing:
             for k, (shape, dtype) in self._slot_shapes.items()}
 
     def _put(self, x):
-        return (jax.device_put(x, self._device) if self._device is not None
-                else jax.device_put(x))
+        return (jax.device_put(x, self._placement)
+                if self._placement is not None else jax.device_put(x))
 
     def nbytes(self) -> int:
         return sum(int(np.prod(a.shape)) * a.dtype.itemsize

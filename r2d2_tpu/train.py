@@ -92,10 +92,12 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                       start_env_steps=start_env_steps,
                       start_minutes=start_minutes)
     ring = None
-    if cfg.device_replay and mesh is None:
+    if cfg.device_replay and jax.process_count() == 1:
+        from r2d2_tpu.parallel.mesh import replicated
         from r2d2_tpu.replay.device_ring import DeviceRing
         from r2d2_tpu.replay.replay_buffer import data_bytes
 
+        # the ring is replicated under a mesh, so the budget is per-device
         need, cap = data_bytes(cfg, action_dim), _device_memory_bytes()
         if cap is None:
             # backend exposes no memory stats (e.g. the CPU client):
@@ -111,12 +113,15 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                 f"device has {cap / 1e9:.1f} GB; falling back to host "
                 "replay — reduce buffer_capacity to fit", stacklevel=2)
         else:
-            ring = DeviceRing(cfg, action_dim)
-    elif cfg.device_replay and mesh is not None:
+            ring = DeviceRing(
+                cfg, action_dim,
+                placement=replicated(mesh) if mesh is not None else None)
+    elif cfg.device_replay:
         import warnings
 
-        warnings.warn("device_replay currently drives the single-device "
-                      "step; using host replay under the mesh", stacklevel=2)
+        warnings.warn(
+            "device_replay is per-process; this multi-host run uses host "
+            "staging instead", stacklevel=2)
     buffer = ReplayBuffer(cfg, action_dim,
                           rng=np.random.default_rng(cfg.seed),
                           device_ring=ring)
