@@ -227,3 +227,58 @@ def test_train_end_to_end_device_replay_under_mesh():
     assert metrics["num_updates"] >= cfg.training_steps
     assert np.isfinite(metrics["mean_loss"])
     assert not metrics["fabric_failed"]
+
+
+def test_run_device_cadences_and_drain(tmp_path):
+    """run_device must fire weight publication and checkpoint cadences on
+    interval crossings even when k doesn't divide them, and harvest the
+    pipelined pending super-step on exit (all priorities reach the sink)."""
+    from r2d2_tpu.checkpoint import Checkpointer
+    from r2d2_tpu.learner.learner import Learner
+    from r2d2_tpu.utils.store import ParamStore
+
+    cfg = make_cfg(training_steps=12, superstep_k=3,
+                   weight_publish_interval=4, save_interval=5)
+    _, dev, ring = paired_buffers(cfg, n_blocks=4)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(5))
+    store = ParamStore()
+    learner = Learner(cfg, net, create_train_state(cfg, params),
+                      param_store=store,
+                      checkpointer=Checkpointer(str(tmp_path)))
+
+    sunk = []
+    metrics = learner.run_device(
+        dev, ring,
+        priority_sink=lambda i, p, ptr, l: sunk.append((i.copy(), p.copy())))
+
+    assert metrics["num_updates"] == 12  # k=3 divides 12: exact
+    # every dispatched sub-batch's priorities were harvested (incl. the
+    # final pending super-step)
+    assert len(sunk) == 12 // 3 * 3
+    # publish crossings at 4, 8, 12 (+1 initial publish at construction)
+    assert store.get()[0] == 4
+    # checkpoint crossings at 5, 10 + the final save
+    ck = Checkpointer(str(tmp_path))
+    assert 12 in ck.steps() and len(ck.steps()) >= 2
+
+
+def test_run_device_stop_midway():
+    """A stop() between super-steps exits promptly and still harvests the
+    in-flight super-step."""
+    from r2d2_tpu.learner.learner import Learner
+
+    cfg = make_cfg(training_steps=1000, superstep_k=2)
+    _, dev, ring = paired_buffers(cfg, n_blocks=4)
+    net = create_network(cfg, A)
+    learner = Learner(cfg, net, create_train_state(
+        cfg, init_params(cfg, net, jax.random.PRNGKey(6))))
+
+    calls = []
+    sunk = []
+    metrics = learner.run_device(
+        dev, ring, priority_sink=lambda i, p, ptr, l: sunk.append(1),
+        stop=lambda: len(calls) >= 3 or calls.append(1))
+
+    assert metrics["num_updates"] == 2 * 3
+    assert len(sunk) == 2 * 3  # nothing stranded in the pipeline
