@@ -19,6 +19,18 @@ inverts the flow:
 Writes are donated ``dynamic_update_index_in_dim`` updates — the ring is
 updated in place on device, never reallocated.
 
+Capacity envelope: the ring must fit one device's HBM (replicated under a
+mesh).  For rings beyond one chip — e.g. the flagship 2M-transition
+buffer (~15.5 GB) on v5e — the multi-host data plane already shards
+capacity per host (each host owns its buffer); a future dp-sharded layout
+for single-process meshes would place ring slot ``s`` at group ``s % dp``
+(round-robin so every group fills from the first block), sample each
+group's rows from its own leaf slice (``SumTree.sample_range``, with IS
+weights normalised across the whole batch), gather inside ``shard_map``
+(each group reads only its local shard — no collectives), and mask stale
+priority feedback by per-slot arrival stamps instead of ring-pointer
+arithmetic.
+
 CONCURRENCY CONTRACT: ``write`` and ``snapshot``+train-step-dispatch must
 be externally serialised (the ReplayBuffer's lock is the coordination
 point — add() writes under it, the learner samples indices and dispatches

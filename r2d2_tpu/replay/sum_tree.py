@@ -48,6 +48,19 @@ class SumTree:
             nodes = np.unique((nodes - 1) // 2)
             self.nodes[nodes] = self.nodes[2 * nodes + 1] + self.nodes[2 * nodes + 2]
 
+    def _descend(self, targets: np.ndarray) -> np.ndarray:
+        """Vectorised lock-step top-down descent: prefix-sum targets →
+        leaf *node* ids (priority_tree.py:26-44 analogue)."""
+        targets = targets.copy()
+        nodes = np.zeros(targets.shape[0], dtype=np.int64)
+        for _ in range(self.num_levels - 1):
+            left = 2 * nodes + 1
+            left_mass = self.nodes[left]
+            go_right = targets >= left_mass
+            nodes = np.where(go_right, left + 1, left)
+            targets = np.where(go_right, targets - left_mass, targets)
+        return nodes
+
     def sample(self, num_samples: int) -> Tuple[np.ndarray, np.ndarray]:
         """Stratified proportional sample of ``num_samples`` leaves.
 
@@ -63,14 +76,7 @@ class SumTree:
         interval = total / num_samples
         targets = interval * np.arange(num_samples, dtype=np.float64)
         targets += self.rng.uniform(0.0, interval, num_samples)
-
-        nodes = np.zeros(num_samples, dtype=np.int64)
-        for _ in range(self.num_levels - 1):
-            left = 2 * nodes + 1
-            left_mass = self.nodes[left]
-            go_right = targets >= left_mass
-            nodes = np.where(go_right, left + 1, left)
-            targets = np.where(go_right, targets - left_mass, targets)
+        nodes = self._descend(targets)
 
         prios = self.nodes[nodes]
         # numerical guard: a descent can land on a zero leaf when float error
@@ -80,3 +86,39 @@ class SumTree:
         prios = np.maximum(prios, min_p)
         is_weights = (prios / min_p) ** (-self.is_exponent)
         return nodes - self.leaf_offset, is_weights
+
+    def prefix_mass(self, leaf_idx: int) -> float:
+        """Total priority mass of all leaves strictly before ``leaf_idx``
+        (O(log n) root walk)."""
+        node = int(leaf_idx) + self.leaf_offset
+        mass = 0.0
+        while node > 0:
+            parent = (node - 1) // 2
+            if node == 2 * parent + 2:  # right child: count left sibling
+                mass += float(self.nodes[2 * parent + 1])
+            node = parent
+        return mass
+
+    def sample_range(self, num_samples: int, lo: int, hi: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Stratified proportional sample restricted to leaves [lo, hi).
+
+        Used by the dp-sharded device ring: each dp group draws its batch
+        rows from its own slice of the leaf space.  Returns (leaf indices,
+        raw sampled priorities) — IS-weight normalisation is the caller's
+        job so it can normalise across ALL groups' draws at once (keeping
+        the reference's min-of-the-whole-batch scheme).
+        """
+        lo_mass = self.prefix_mass(lo)
+        mass = self.prefix_mass(hi) - lo_mass
+        if mass <= 0:
+            raise ValueError(
+                f"cannot sample from empty leaf range [{lo}, {hi})")
+        interval = mass / num_samples
+        targets = lo_mass + interval * np.arange(num_samples,
+                                                 dtype=np.float64)
+        targets += self.rng.uniform(0.0, interval, num_samples)
+        idxes = self._descend(targets) - self.leaf_offset
+        # float error at stratum boundaries can step just outside the range
+        idxes = np.clip(idxes, lo, hi - 1)
+        return idxes, self.nodes[idxes + self.leaf_offset].copy()

@@ -62,3 +62,39 @@ def test_empty_tree_raises():
     t = make_tree()
     with pytest.raises(ValueError):
         t.sample(4)
+
+
+def test_prefix_mass_matches_cumsum():
+    rng = np.random.default_rng(5)
+    tree = SumTree(37, prio_exponent=0.9, is_exponent=0.6,
+                   rng=np.random.default_rng(0))
+    prios = rng.random(37).astype(np.float64) + 0.01
+    tree.update(np.arange(37), prios)
+    leaf = tree.nodes[tree.leaf_offset:tree.leaf_offset + 37]
+    cum = np.concatenate([[0.0], np.cumsum(leaf)])
+    for i in (0, 1, 5, 17, 36, 37):
+        assert tree.prefix_mass(i) == pytest.approx(cum[i], rel=1e-12)
+
+
+def test_sample_range_stays_in_range_and_is_proportional():
+    rng = np.random.default_rng(6)
+    tree = SumTree(64, prio_exponent=1.0, is_exponent=0.6,
+                   rng=np.random.default_rng(1))
+    prios = rng.random(64) + 0.05
+    tree.update(np.arange(64), prios)
+
+    lo, hi = 16, 48
+    counts = np.zeros(64)
+    for _ in range(300):
+        idx, p = tree.sample_range(8, lo, hi)
+        assert ((idx >= lo) & (idx < hi)).all()
+        np.testing.assert_allclose(
+            p, tree.nodes[idx + tree.leaf_offset], rtol=1e-12)
+        np.testing.assert_array_equal(np.sort(idx), idx)  # stratified order
+        counts[idx] += 1
+    assert counts[:lo].sum() == 0 and counts[hi:].sum() == 0
+    # proportionality within the range: higher-priority leaves sampled more
+    leaf = tree.nodes[tree.leaf_offset + lo:tree.leaf_offset + hi]
+    freq = counts[lo:hi] / counts[lo:hi].sum()
+    expect = leaf / leaf.sum()
+    np.testing.assert_allclose(freq, expect, atol=0.02)
