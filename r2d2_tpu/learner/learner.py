@@ -269,8 +269,8 @@ class Learner:
         ``cfg.device_replay`` — batch bytes never cross the host↔device
         boundary, so throughput is immune to interconnect latency (the
         reference's `.to(device)` per step, worker.py:330-342, is the cost
-        this removes).  Single-process, single-device; the mesh path keeps
-        host staging.
+        this removes).  Single-process only; multi-host runs use
+        :meth:`run` (each host's ring would hold different data).
 
         The update counter advances by k per dispatch, so the loop may
         overshoot ``training_steps`` by up to k-1 updates.
@@ -292,7 +292,6 @@ class Learner:
         t0 = time.time()
         updates = self.num_updates
         target = cfg.training_steps if max_steps is None else updates + max_steps
-
         # AOT-compile outside the buffer lock: the first dispatch happens
         # under it (sample_meta couples sampling + dispatch), and tracing a
         # fresh jit there would stall actor add()s for the whole compile
@@ -304,10 +303,16 @@ class Learner:
         else:
             super_fn = make_super_step(cfg, self.net, k)
         B = cfg.batch_size
-        compiled = super_fn.lower(
-            self.state, ring.snapshot(),
-            np.zeros((k, B, 6), np.int32),
-            np.zeros((k, B), np.float32)).compile()
+        try:
+            super_fn = super_fn.lower(
+                self.state, ring.snapshot(),
+                np.zeros((k, B, 6), np.int32),
+                np.zeros((k, B), np.float32)).compile()
+        except Exception:
+            # some plugin backends lack the AOT API; the jit wrapper
+            # compiles at first call instead (stalling the lock once)
+            pass
+        compiled = super_fn
 
         losses_hist = []
 
