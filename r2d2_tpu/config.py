@@ -85,6 +85,10 @@ class Config:
                                       # train.py:30-34); 0/1 = serial
     device_replay: bool = False       # replay data lives in HBM; batches
                                       # are gathered in-graph (device_ring)
+    device_ring_layout: str = "auto"  # "replicated" (full ring per device)
+                                      # | "dp" (ring sharded over dp, per-
+                                      # group sampling) | "auto" (replicate
+                                      # if it fits, else shard)
     superstep_k: int = 8              # train steps fused per dispatch when
                                       # device_replay (learner/step.py)
     act_device: str = "auto"          # actor inference backend: "auto"
@@ -144,6 +148,9 @@ class Config:
             raise ValueError("env_workers must be >= 0")
         if self.superstep_k < 1:
             raise ValueError("superstep_k must be >= 1")
+        if self.device_ring_layout not in ("auto", "replicated", "dp"):
+            raise ValueError(
+                f"unknown device_ring_layout {self.device_ring_layout!r}")
         if self.act_device not in ("auto", "cpu", "default"):
             raise ValueError(f"unknown act_device {self.act_device!r}")
         if self.torso not in ("nature", "impala", "mlp"):

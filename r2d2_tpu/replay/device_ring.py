@@ -75,12 +75,14 @@ def _slot_shapes(cfg: Config, action_dim: int) -> Dict[str, Any]:
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _write_slot(arrays: Dict[str, jnp.ndarray],
-                slot: Dict[str, jnp.ndarray], ptr: jnp.ndarray):
+def _write_slot_fn(arrays: Dict[str, jnp.ndarray],
+                   slot: Dict[str, jnp.ndarray], ptr: jnp.ndarray):
     return {k: jax.lax.dynamic_update_index_in_dim(arrays[k], slot[k], ptr,
                                                    axis=0)
             for k in arrays}
+
+
+_write_slot = jax.jit(_write_slot_fn, donate_argnums=(0,))
 
 
 def gather_batch(cfg: Config, arrays: Dict[str, jnp.ndarray],
@@ -118,27 +120,54 @@ def gather_batch(cfg: Config, arrays: Dict[str, jnp.ndarray],
     )
 
 
-def ring_sharding(mesh) -> Dict[str, Any]:
-    """Replicated-over-the-mesh sharding for every ring array (each device
-    holds the full ring; gathers then need no collectives)."""
+def ring_sharding(mesh, layout: str = "replicated") -> Dict[str, Any]:
+    """Mesh sharding for every ring array.
+
+    "replicated": each device holds the full ring — gathers need no
+    collectives, capacity is bounded by one chip's HBM.
+    "dp": the slot axis shards over ``dp`` — capacity scales with the
+    mesh; each dp group gathers only from its own shard (via shard_map in
+    ``parallel.mesh.sharded_super_step``) and sampling draws each group's
+    batch rows from its own slot range.
+    """
     from jax.sharding import NamedSharding, PartitionSpec
 
-    rep = NamedSharding(mesh, PartitionSpec())
-    return {k: rep for k in _DATA_KEYS}
+    spec = (PartitionSpec("dp") if layout == "dp" else PartitionSpec())
+    sh = NamedSharding(mesh, spec)
+    return {k: sh for k in _DATA_KEYS}
 
 
 class DeviceRing:
     """Owns the device-resident ring arrays and their write path.
 
-    ``placement`` may be a Device (single-chip) or a Sharding — pass
-    ``NamedSharding(mesh, P())`` (see :func:`ring_sharding`) to replicate
-    the ring across a mesh for the sharded super-step.
+    ``placement`` may be a Device (single-chip) or a Sharding; use
+    ``mesh=..., layout=...`` instead to derive it (see
+    :func:`ring_sharding`).  ``layout="dp"`` additionally sets
+    ``num_groups`` — the replay buffer then walks ring slots round-robin
+    across the dp groups' slot ranges and samples each group's batch rows
+    from its own slots.
     """
 
     def __init__(self, cfg: Config, action_dim: int,
-                 placement: Optional[Any] = None):
+                 placement: Optional[Any] = None,
+                 mesh: Optional[Any] = None, layout: str = "replicated"):
         self.cfg = cfg
         self.action_dim = action_dim
+        self.layout = layout
+        self.num_groups = 1
+        self._slot_placement = placement  # incoming slots: device or repl.
+        if mesh is not None:
+            if layout == "dp":
+                dp = mesh.shape["dp"]
+                if cfg.num_blocks % dp:
+                    raise ValueError(
+                        f"device_ring_layout='dp' needs num_blocks "
+                        f"({cfg.num_blocks}) divisible by dp={dp}")
+                self.num_groups = dp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            placement = ring_sharding(mesh, layout)["obs"]
+            self._slot_placement = NamedSharding(mesh, PartitionSpec())
         self._placement = placement
         NB = cfg.num_blocks
         self._slot_shapes = _slot_shapes(cfg, action_dim)
@@ -149,6 +178,10 @@ class DeviceRing:
     def _put(self, x):
         return (jax.device_put(x, self._placement)
                 if self._placement is not None else jax.device_put(x))
+
+    def _put_slot(self, x):
+        return (jax.device_put(x, self._slot_placement)
+                if self._slot_placement is not None else jax.device_put(x))
 
     def nbytes(self) -> int:
         return sum(int(np.prod(a.shape)) * a.dtype.itemsize
@@ -171,7 +204,7 @@ class DeviceRing:
                 arr[:block.num_sequences] = src
             else:
                 arr[:src.shape[0]] = src
-            slot[k] = self._put(arr)
+            slot[k] = self._put_slot(arr)
         self.arrays = _write_slot(self.arrays, slot,
                                   jnp.asarray(ptr, jnp.int32))
 
