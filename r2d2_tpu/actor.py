@@ -99,12 +99,34 @@ def _resolve_act_device(spec: str):
 def make_act_fn(cfg: Config, net: R2D2Network):
     """Jitted batched single-step inference:
     (params, obs (B,*obs) u8, last_action (B,A) f32, last_reward (B,) f32,
-    hidden (B,2,layers,H)) → (q (B,A) f32, new hidden)."""
+    hidden (B,2,layers,H)) → (q (B,A) f32, new hidden).
+
+    When actor inference runs on the host CPU backend (``cfg.act_device``
+    "auto"/"cpu" with an accelerator default backend — see
+    :func:`_resolve_act_device`) but the learner's network resolved the
+    fused Pallas LSTM (TPU-only lowering), acting uses a **scan-impl twin**
+    of the network: the two implementations declare identical parameters
+    (models/network.py:resolve_lstm_impl), so the published param
+    snapshots apply unchanged — the recurrence engine is just re-chosen
+    for the platform the jit will actually lower on."""
+    from r2d2_tpu.models.network import create_network, resolve_lstm_impl
+
+    act_net = net
+    if resolve_lstm_impl(cfg) == "pallas" and not cfg.pallas_interpret:
+        act_dev = _resolve_act_device(cfg.act_device)
+        # act_dev None = inference stays wherever the default backend puts
+        # it (e.g. evaluating a TPU-trained, explicitly-pallas config on a
+        # CPU-only host) — judge by that platform instead
+        platform = (act_dev.platform if act_dev is not None
+                    else jax.default_backend())
+        if platform != "tpu":
+            act_net = create_network(cfg.replace(lstm_impl="scan"),
+                                     net.action_dim)
 
     @jax.jit
     def act(params, obs, last_action, last_reward, hidden):
-        return net.apply(params, obs, last_action, last_reward, hidden,
-                         method=R2D2Network.act)
+        return act_net.apply(params, obs, last_action, last_reward, hidden,
+                             method=R2D2Network.act)
 
     return act
 

@@ -157,30 +157,41 @@ class LSTMLayer(nn.Module):
 
         x_proj = (xs.astype(cd) @ wi.astype(cd)).astype(jnp.float32) + b
 
-        if self.impl == "pallas":
+        def run_pallas(xp, wh, h0, c0):
             from r2d2_tpu.ops.lstm import lstm_unroll_pallas
 
             hs_tm, h, c = lstm_unroll_pallas(
-                x_proj.swapaxes(0, 1), wh,
-                h0.astype(jnp.float32), c0.astype(jnp.float32),
+                xp.swapaxes(0, 1), wh, h0, c0,
                 compute_dtype=cd, interpret=self.interpret)
-            return hs_tm.swapaxes(0, 1), (h, c)
+            return hs_tm.swapaxes(0, 1), h, c
 
-        def step(carry, x_t):
-            h, c = carry
-            gates = x_t + (h.astype(cd) @ wh.astype(cd)).astype(jnp.float32)
-            i, f, g, o = jnp.split(gates, 4, axis=-1)
-            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
-            return (h_new, c_new), h_new
+        def run_scan(xp, wh, h0, c0):
+            def step(carry, x_t):
+                h, c = carry
+                gates = x_t + (h.astype(cd) @ wh.astype(cd)).astype(
+                    jnp.float32)
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c_new = (jax.nn.sigmoid(f) * c
+                         + jax.nn.sigmoid(i) * jnp.tanh(g))
+                h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
 
-        if self.remat:
-            step = jax.checkpoint(step)
+            if self.remat:
+                step = jax.checkpoint(step)
+            (h, c), hs = jax.lax.scan(step, (h0, c0), xp.swapaxes(0, 1))
+            return hs.swapaxes(0, 1), h, c
 
-        (h, c), hs = jax.lax.scan(step, (h0.astype(jnp.float32),
-                                         c0.astype(jnp.float32)),
-                                  x_proj.swapaxes(0, 1))
-        return hs.swapaxes(0, 1), (h, c)
+        h0f, c0f = h0.astype(jnp.float32), c0.astype(jnp.float32)
+        # The pallas branch only lowers on TPU (interpret=True is the CPU
+        # test mode).  Callers that jit the network onto a non-TPU device —
+        # actor/eval inference on the host CPU backend — must request a
+        # scan-impl network instead (actor.make_act_fn builds that twin;
+        # the two impls declare identical parameters).
+        if self.impl == "pallas":
+            hs, h, c = run_pallas(x_proj, wh, h0f, c0f)
+        else:
+            hs, h, c = run_scan(x_proj, wh, h0f, c0f)
+        return hs, (h, c)
 
 
 class DuelingHead(nn.Module):

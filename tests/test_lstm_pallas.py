@@ -123,6 +123,38 @@ def test_network_pallas_matches_scan_end_to_end():
         a, b_, rtol=5e-3, atol=1e-5), g_s, g_p)
 
 
+def test_act_fn_uses_scan_twin_off_tpu():
+    """Regression: on a TPU default backend the learner's network resolves
+    impl=pallas, but actor inference jits onto the host CPU backend
+    (actor.py:_resolve_act_device) where compiled pallas cannot lower
+    ("Only interpret mode is supported on CPU backend").  make_act_fn must
+    therefore build a scan-impl twin whenever the resolved act device is
+    not a TPU — reproduced here with an explicit impl=pallas config and
+    act_device="cpu" (the exact combination the real-TPU bench hits with
+    lstm_impl="auto", act_device="auto")."""
+    from r2d2_tpu.actor import make_act_fn
+    from r2d2_tpu.config import test_config
+    from r2d2_tpu.models.network import R2D2Network, create_network, init_params
+    from r2d2_tpu.utils.batch import synthetic_batch
+
+    cfg = test_config(lstm_impl="pallas", act_device="cpu")  # interpret=False
+    A = 4
+    net_p = create_network(cfg, A)
+    net_s = create_network(cfg.replace(lstm_impl="scan"), A)
+    params = init_params(cfg, net_s, jax.random.PRNGKey(5))
+    b = synthetic_batch(cfg, A, np.random.default_rng(2))
+
+    act = make_act_fn(cfg, net_p)
+    # without the twin this raises at lowering time on the CPU backend
+    q, hid = act(params, b["obs"][:, 0], b["last_action"][:, 0],
+                 b["last_reward"][:, 0], b["hidden"])
+    q_s, hid_s = net_s.apply(params, b["obs"][:, 0], b["last_action"][:, 0],
+                             b["last_reward"][:, 0], b["hidden"],
+                             method=R2D2Network.act)
+    np.testing.assert_allclose(q, q_s, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hid, hid_s, rtol=1e-5, atol=1e-5)
+
+
 def test_bf16_compute_close_to_f32(inputs):
     """bf16 matmul with f32 accumulation stays within bf16 tolerance."""
     xp, wh, h0, c0 = inputs
