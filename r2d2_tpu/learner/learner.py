@@ -298,8 +298,9 @@ class Learner:
         if self.mesh is not None:
             from r2d2_tpu.parallel.mesh import sharded_super_step
 
-            super_fn = sharded_super_step(cfg, self.net, self.mesh, k,
-                                          state_template=self.state)
+            super_fn = sharded_super_step(
+                cfg, self.net, self.mesh, k, state_template=self.state,
+                layout=getattr(ring, "layout", "replicated"))
         else:
             super_fn = make_super_step(cfg, self.net, k)
         B = cfg.batch_size

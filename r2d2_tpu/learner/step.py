@@ -179,7 +179,7 @@ def jit_train_step(cfg: Config, net: R2D2Network):
     return jax.jit(make_train_step(cfg, net), donate_argnums=(0,))
 
 
-def make_super_step_fn(cfg: Config, net: R2D2Network, k: int):
+def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
     """The unjitted ``k``-fused-steps function — batches gathered in-graph
     from the device-resident replay ring (replay/device_ring.py).
 
@@ -190,6 +190,10 @@ def make_super_step_fn(cfg: Config, net: R2D2Network, k: int):
     step is exactly ``make_train_step`` — target sync and the step counter
     advance per inner step, so k super-steps ≡ k·1 plain steps.
 
+    ``gather(arrays, ints_t (B,6), w_t (B,)) -> batch`` defaults to the
+    plain in-graph gather; ``parallel.mesh.sharded_super_step`` passes a
+    shard_map-wrapped variant for dp-sharded rings.
+
     Signature: ``super_step(state, ring_arrays, ints (k,B,6) i32,
     is_weights (k,B) f32) -> (state, losses (k,), priorities (k,B))``.
     Wrap with :func:`make_super_step` (single device) or
@@ -197,12 +201,14 @@ def make_super_step_fn(cfg: Config, net: R2D2Network, k: int):
     """
     from r2d2_tpu.replay.device_ring import gather_batch
 
+    if gather is None:
+        gather = functools.partial(gather_batch, cfg)
     step = make_train_step(cfg, net)
 
     def super_step(state: TrainState, arrays, ints, is_weights):
         def body(st, x):
             ints_t, w_t = x
-            batch = gather_batch(cfg, arrays, ints_t, w_t)
+            batch = gather(arrays, ints_t, w_t)
             st, loss, priorities = step(st, batch)
             return st, (loss, priorities)
 

@@ -182,39 +182,72 @@ def _mesh_net(cfg: Config, net: R2D2Network) -> R2D2Network:
 
 
 def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
-                       state_template: Optional[TrainState] = None):
+                       state_template: Optional[TrainState] = None,
+                       layout: str = "replicated"):
     """The device-replay super-step compiled over the mesh.
 
-    Layout: the HBM ring is **replicated** across the mesh (every device
-    holds the full ring — writes broadcast once per block), the index
-    bundles and is_weights shard their batch axis (axis 1) over ``dp``,
-    and the in-graph gather therefore produces a dp-sharded batch with no
-    collectives: each device gathers only its rows from its local ring
-    replica.  Params follow the same rules as :func:`sharded_train_step`,
-    so grad psums ride ICI exactly as in the host-staged path.
+    The index bundles and is_weights shard their batch axis (axis 1) over
+    ``dp``; params follow the same rules as :func:`sharded_train_step`, so
+    grad psums ride ICI exactly as in the host-staged path.  The HBM ring
+    follows ``layout`` (replay/device_ring.ring_sharding):
+
+    - ``"replicated"``: every device holds the full ring (writes broadcast
+      once per block); the plain in-graph gather produces a dp-sharded
+      batch with no collectives — each device gathers its rows from its
+      local replica.
+    - ``"dp"``: the slot axis shards over dp — capacity scales with the
+      mesh.  The gather runs inside ``shard_map``: each dp group receives
+      its slot slab plus its rows of the index bundle (the ReplayBuffer
+      samples row chunk g from group g's slots — replay_buffer.sample_meta)
+      and localises the global slot index by its ``axis_index("dp")``
+      offset.  Still no collectives in the data plane; only the grad psum
+      crosses ICI.
 
     Single-process only (each host's ring holds its own buffer's data, so
-    a multi-host mesh cannot see one coherent replicated ring) — the
-    caller guards.
+    a multi-host mesh cannot see one coherent ring) — the caller guards.
     """
-    if cfg.batch_size % mesh.shape["dp"] != 0:
+    dp = mesh.shape["dp"]
+    if cfg.batch_size % dp != 0:
         raise ValueError(
-            f"batch_size {cfg.batch_size} not divisible by "
-            f"dp={mesh.shape['dp']}")
+            f"batch_size {cfg.batch_size} not divisible by dp={dp}")
     if "mp" in mesh.axis_names and state_template is None:
         raise ValueError("an mp mesh needs state_template to derive "
                          "per-parameter shardings")
     from r2d2_tpu.learner.step import make_super_step_fn
-    from r2d2_tpu.replay.device_ring import ring_sharding
+    from r2d2_tpu.replay.device_ring import gather_batch, ring_sharding
 
-    fn = make_super_step_fn(cfg, _mesh_net(cfg, net), k)
+    gather = None
+    if layout == "dp":
+        from jax import shard_map
+
+        if cfg.num_blocks % dp:
+            raise ValueError(
+                f"layout='dp' needs num_blocks ({cfg.num_blocks}) "
+                f"divisible by dp={dp}")
+        blocks_per_group = cfg.num_blocks // dp
+
+        def local_gather(arrays, ints_t, w_t):
+            gid = jax.lax.axis_index("dp")
+            ints_local = ints_t.at[:, 0].add(-gid * blocks_per_group)
+            return gather_batch(cfg, arrays, ints_local, w_t)
+
+        def gather(arrays, ints_t, w_t):
+            # in/out specs as pytree prefixes: ring slot axis and batch
+            # rows split over dp; mp (if present) sees replicated inputs
+            # and identical outputs, which varying-axis inference proves
+            return shard_map(
+                local_gather, mesh=mesh,
+                in_specs=(P("dp"), P("dp"), P("dp")),
+                out_specs=P("dp"))(arrays, ints_t, w_t)
+
+    fn = make_super_step_fn(cfg, _mesh_net(cfg, net), k, gather=gather)
     repl = replicated(mesh)
     dp_b = NamedSharding(mesh, P(None, "dp"))
     st_shard = (state_shardings(mesh, state_template)
                 if state_template is not None else repl)
     return jax.jit(
         fn,
-        in_shardings=(st_shard, ring_sharding(mesh), dp_b, dp_b),
+        in_shardings=(st_shard, ring_sharding(mesh, layout), dp_b, dp_b),
         out_shardings=(st_shard, repl, dp_b),
         donate_argnums=(0,),
     )

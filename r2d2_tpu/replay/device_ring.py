@@ -19,17 +19,23 @@ inverts the flow:
 Writes are donated ``dynamic_update_index_in_dim`` updates — the ring is
 updated in place on device, never reallocated.
 
-Capacity envelope: the ring must fit one device's HBM (replicated under a
-mesh).  For rings beyond one chip — e.g. the flagship 2M-transition
-buffer (~15.5 GB) on v5e — the multi-host data plane already shards
-capacity per host (each host owns its buffer); a future dp-sharded layout
-for single-process meshes would place ring slot ``s`` at group ``s % dp``
-(round-robin so every group fills from the first block), sample each
-group's rows from its own leaf slice (``SumTree.sample_range``, with IS
-weights normalised across the whole batch), gather inside ``shard_map``
-(each group reads only its local shard — no collectives), and mask stale
-priority feedback by per-slot arrival stamps instead of ring-pointer
-arithmetic.
+Capacity envelope — two mesh layouts (``layout=``):
+
+- ``"replicated"``: every device holds the full ring; gathers need no
+  collectives, capacity is bounded by ONE chip's HBM.
+- ``"dp"``: the slot axis shards over the ``dp`` mesh axis, so capacity
+  scales with the mesh — e.g. the flagship 2M-transition buffer
+  (~15.5 GB) does not fit a single v5e chip (16 GB) next to params, but
+  dp=8 holds ~2 GB/chip.  The ReplayBuffer walks ring slots round-robin
+  across the dp groups' contiguous slot slabs (every group fills from the
+  first block; replay_buffer._phys_block), samples each group's batch
+  rows from its own leaf slice (``SumTree.sample_range``, IS weights
+  min-normalised across the whole batch), and maps physical slots back to
+  the logical FIFO walk for stale-feedback masking.  The in-graph gather
+  runs inside ``shard_map`` — each dp group reads only its local shard,
+  no collectives (parallel.mesh.sharded_super_step(layout="dp")).
+  Multi-host meshes instead shard capacity per host (each host owns its
+  buffer; learner/learner.py uses host staging there).
 
 CONCURRENCY CONTRACT: ``write`` and ``snapshot``+train-step-dispatch must
 be externally serialised (the ReplayBuffer's lock is the coordination
@@ -132,9 +138,49 @@ def ring_sharding(mesh, layout: str = "replicated") -> Dict[str, Any]:
     """
     from jax.sharding import NamedSharding, PartitionSpec
 
+    if layout not in ("replicated", "dp"):
+        raise ValueError(f"unknown device-ring layout {layout!r} "
+                         "(expected 'replicated' or 'dp')")
     spec = (PartitionSpec("dp") if layout == "dp" else PartitionSpec())
     sh = NamedSharding(mesh, spec)
     return {k: sh for k in _DATA_KEYS}
+
+
+def resolve_layout(cfg: Config, mesh, need_bytes: int,
+                   cap_bytes: Optional[int]) -> str:
+    """Resolve ``cfg.device_ring_layout`` to a concrete mesh layout.
+
+    ``"auto"`` shards the ring over dp exactly when the full ring would
+    not fit one device's HBM budget (80%, leaving headroom for params,
+    activations and staged slots) AND the shapes allow it (num_blocks and
+    batch_size divisible by dp).  Explicit ``"dp"`` raises when the
+    shapes or mesh make it impossible — silent fallback would defeat the
+    reason the user asked for sharding (review: a knob that validates but
+    does nothing).
+    """
+    requested = cfg.device_ring_layout
+    has_dp = (mesh is not None and "dp" in mesh.axis_names
+              and mesh.shape["dp"] > 1)
+    if not has_dp:
+        if requested == "dp":
+            raise ValueError(
+                "device_ring_layout='dp' needs a mesh with a dp axis > 1")
+        return "replicated"
+    dp = mesh.shape["dp"]
+    can_dp = (cfg.num_blocks % dp == 0) and (cfg.batch_size % dp == 0)
+    if requested == "dp":
+        if not can_dp:
+            raise ValueError(
+                f"device_ring_layout='dp' needs num_blocks "
+                f"({cfg.num_blocks}) and batch_size ({cfg.batch_size}) "
+                f"divisible by dp={dp}")
+        return "dp"
+    if requested == "replicated":
+        return "replicated"
+    # "auto": replicate if it fits, shard if it must and can
+    if can_dp and cap_bytes is not None and need_bytes > 0.8 * cap_bytes:
+        return "dp"
+    return "replicated"
 
 
 class DeviceRing:
@@ -156,6 +202,7 @@ class DeviceRing:
         self.layout = layout
         self.num_groups = 1
         self._slot_placement = placement  # incoming slots: device or repl.
+        self._write_fn = _write_slot
         if mesh is not None:
             if layout == "dp":
                 dp = mesh.shape["dp"]
@@ -166,10 +213,18 @@ class DeviceRing:
                 self.num_groups = dp
             from jax.sharding import NamedSharding, PartitionSpec
 
-            placement = ring_sharding(mesh, layout)["obs"]
+            sharding = ring_sharding(mesh, layout)
+            placement = sharding["obs"]
             self._slot_placement = NamedSharding(mesh, PartitionSpec())
+            # pin the write's output layout: GSPMD would usually preserve
+            # the donated input sharding, but with a dp-sharded slot axis
+            # the partitioner must not be left free to re-lay-out the ring
+            self._write_fn = jax.jit(
+                _write_slot_fn, donate_argnums=(0,),
+                out_shardings={k: sharding[k] for k in _DATA_KEYS})
         self._placement = placement
         NB = cfg.num_blocks
+        self.blocks_per_group = NB // self.num_groups
         self._slot_shapes = _slot_shapes(cfg, action_dim)
         self.arrays = {
             k: self._put(np.zeros((NB, *shape), dtype))
@@ -188,8 +243,9 @@ class DeviceRing:
                    for a in self.arrays.values())
 
     def write(self, block: Block, ptr: int) -> None:
-        """Stream one block into ring slot ``ptr`` (H2D once per block;
-        caller holds the coordinating lock — see the module contract).
+        """Stream one block into (physical) ring slot ``ptr`` (H2D once per
+        block; caller holds the coordinating lock — see the module
+        contract).
 
         Short blocks are zero-padded to the fixed slot shape; the padding
         occupies exactly the positions the host ring would leave stale,
@@ -205,8 +261,8 @@ class DeviceRing:
             else:
                 arr[:src.shape[0]] = src
             slot[k] = self._put_slot(arr)
-        self.arrays = _write_slot(self.arrays, slot,
-                                  jnp.asarray(ptr, jnp.int32))
+        self.arrays = self._write_fn(self.arrays, slot,
+                                     jnp.asarray(ptr, jnp.int32))
 
     def snapshot(self) -> Dict[str, jnp.ndarray]:
         """Current ring handles, safe to pass to a train-step dispatch

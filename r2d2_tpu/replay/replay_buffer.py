@@ -108,6 +108,19 @@ class ReplayBuffer:
         self.action_dim = action_dim
         self.device_ring = device_ring
 
+        # Slot groups (dp-sharded device ring): the ring's slot axis is
+        # partitioned into G contiguous slabs, one per dp mesh group.  The
+        # logical FIFO walk maps onto physical slots round-robin across the
+        # slabs (see _phys_block) so every group fills from the first
+        # block, and sampling draws each group's batch rows from its own
+        # slab (sample_meta) so the in-graph gather never crosses shards.
+        # G == 1 (host ring / replicated device ring) makes every mapping
+        # the identity.
+        self.G = (getattr(device_ring, "num_groups", 1)
+                  if device_ring is not None else 1)
+        assert cfg.num_blocks % self.G == 0  # DeviceRing validated this
+        self._blocks_per_group = cfg.num_blocks // self.G
+
         spec = _count_spec(cfg) if device_ring is not None else _ring_spec(
             cfg, action_dim)
         # Fail fast with an actionable message instead of letting the
@@ -144,9 +157,36 @@ class ReplayBuffer:
     def __len__(self) -> int:
         return self.size
 
+    def _phys_block(self, n):
+        """Logical ring position → physical slot (round-robin over the G
+        group slabs; identity for G == 1).  Bijection on [0, num_blocks)."""
+        return (n % self.G) * self._blocks_per_group + n // self.G
+
+    def _log_block(self, p):
+        """Physical slot → logical ring position (inverse of
+        :meth:`_phys_block`)."""
+        return (p % self._blocks_per_group) * self.G + p // self._blocks_per_group
+
     @property
     def ready(self) -> bool:
-        return self.size >= self.cfg.learning_starts
+        if self.size < self.cfg.learning_starts:
+            return False
+        if self.G > 1:
+            # per-group sampling needs every slab non-empty; round-robin
+            # fill reaches all slabs within the first G blocks, long before
+            # any realistic learning_starts, but guard the degenerate case.
+            # Unlike the GIL-atomic `size` read above, the mass walk spans
+            # many tree nodes — take the lock so a concurrent update's
+            # level-order repair can't produce a torn (spuriously positive)
+            # difference.
+            K = self.cfg.seqs_per_block
+            span = self._blocks_per_group * K
+            with self.lock:
+                if any(self.tree.prefix_mass((g + 1) * span)
+                       - self.tree.prefix_mass(g * span) <= 0.0
+                       for g in range(self.G)):
+                    return False
+        return True
 
     # ------------------------------------------------------------------ add
     def add(self, block: Block, priorities: np.ndarray,
@@ -156,37 +196,40 @@ class ReplayBuffer:
         K = cfg.seqs_per_block
         with self.lock:
             ptr = self.block_ptr
-            leaf_idxes = np.arange(ptr * K, (ptr + 1) * K, dtype=np.int64)
+            # every array (and the PER leaves) is keyed by the PHYSICAL
+            # slot; the logical ptr only orders the FIFO walk
+            slot = self._phys_block(ptr)
+            leaf_idxes = np.arange(slot * K, (slot + 1) * K, dtype=np.int64)
             self.tree.update(leaf_idxes, priorities)
 
-            self.size -= int(self.block_learning_total[ptr])
+            self.size -= int(self.block_learning_total[slot])
 
             k = block.num_sequences
             if self.device_ring is not None:
                 # bulk data goes straight to HBM (once per block); the
                 # stream-order/donation contract is upheld because we hold
                 # self.lock, the same lock sample_meta dispatches under
-                self.device_ring.write(block, ptr)
+                self.device_ring.write(block, slot)
             else:
                 n_obs = block.obs.shape[0]
                 n_steps = block.action.shape[0]
-                self.obs[ptr, :n_obs] = block.obs
-                self.last_action[ptr, :n_obs] = block.last_action
-                self.last_reward[ptr, :n_obs] = block.last_reward
-                self.action[ptr, :n_steps] = block.action
-                self.n_step_reward[ptr, :n_steps] = block.n_step_reward
-                self.n_step_gamma[ptr, :n_steps] = block.n_step_gamma
-                self.hidden[ptr, :k] = block.hidden
-            self.burn_in_steps[ptr] = 0
-            self.learning_steps[ptr] = 0
-            self.forward_steps[ptr] = 0
-            self.burn_in_steps[ptr, :k] = block.burn_in_steps
-            self.learning_steps[ptr, :k] = block.learning_steps
-            self.forward_steps[ptr, :k] = block.forward_steps
-            self.first_burn_in[ptr] = int(block.burn_in_steps[0])
+                self.obs[slot, :n_obs] = block.obs
+                self.last_action[slot, :n_obs] = block.last_action
+                self.last_reward[slot, :n_obs] = block.last_reward
+                self.action[slot, :n_steps] = block.action
+                self.n_step_reward[slot, :n_steps] = block.n_step_reward
+                self.n_step_gamma[slot, :n_steps] = block.n_step_gamma
+                self.hidden[slot, :k] = block.hidden
+            self.burn_in_steps[slot] = 0
+            self.learning_steps[slot] = 0
+            self.forward_steps[slot] = 0
+            self.burn_in_steps[slot, :k] = block.burn_in_steps
+            self.learning_steps[slot, :k] = block.learning_steps
+            self.forward_steps[slot, :k] = block.forward_steps
+            self.first_burn_in[slot] = int(block.burn_in_steps[0])
 
             total = int(block.learning_steps.sum())
-            self.block_learning_total[ptr] = total
+            self.block_learning_total[slot] = total
             self.size += total
             self.env_steps += total
 
@@ -282,12 +325,28 @@ class ReplayBuffer:
         ``meta["dispatched"]`` — this orders the train-step dispatch before
         any later ring write (the device_ring concurrency contract).
 
+        dp-sharded rings (G > 1): batch rows [g·B/G, (g+1)·B/G) are drawn
+        from group g's slab via :meth:`SumTree.sample_range`, so row chunk
+        g — which a ``P(None, "dp")`` sharding places on dp-index g — only
+        references slots that device group holds.  Priorities still drive
+        selection *within* each group; the fixed B/G per-group allocation
+        is the one deviation from global stratified sampling (group
+        assignment is round-robin, i.e. priority-independent, so group
+        masses stay near-equal).  IS weights are exact for the realised
+        distribution: row inclusion density is prio/mass_group, and weights
+        are ``(q/min_q)^-beta`` min-normalised across the WHOLE batch —
+        the reference scheme applied to the true per-group probabilities.
+
         Returns ints (k,B,6) i32 · is_weights (k,B) f32 · idxes (k,B) i64 ·
         block_ptr · env_steps.
         """
         cfg = self.cfg
         B = batch_size or cfg.batch_size
         K, L = cfg.seqs_per_block, cfg.learning_steps
+        if B % self.G:
+            raise ValueError(
+                f"batch_size {B} not divisible by the ring's {self.G} "
+                "slot groups")
         ints = np.empty((k, B, 6), np.int32)
         weights = np.empty((k, B), np.float32)
         idxes = np.empty((k, B), np.int64)
@@ -297,7 +356,10 @@ class ReplayBuffer:
                     "sample_meta on an empty buffer; wait for add() (use "
                     "`ready` to gate on learning_starts)")
             for j in range(k):
-                idx, w = self.tree.sample(B)
+                if self.G == 1:
+                    idx, w = self.tree.sample(B)
+                else:
+                    idx, w = self._sample_grouped(B)
                 block_idx = idx // K
                 seq_idx = idx % K
                 burn_in = self.burn_in_steps[block_idx, seq_idx].astype(
@@ -317,18 +379,49 @@ class ReplayBuffer:
                 meta["dispatched"] = dispatch(ints, weights)
         return meta
 
+    def _sample_grouped(self, B: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One B-row draw for a G-group ring: B/G rows per group slab,
+        IS weights from the per-group inclusion densities (caller holds
+        the lock)."""
+        K = self.cfg.seqs_per_block
+        span = self._blocks_per_group * K
+        per = B // self.G
+        idx_parts, q_parts = [], []
+        for g in range(self.G):
+            lo, hi = g * span, (g + 1) * span
+            part, prios = self.tree.sample_range(per, lo, hi)
+            mass = self.tree.prefix_mass(hi) - self.tree.prefix_mass(lo)
+            idx_parts.append(part)
+            q_parts.append(prios / mass)
+        idx = np.concatenate(idx_parts)
+        q = np.concatenate(q_parts)
+        # zero-leaf guard, mirroring SumTree.sample: clamp to the smallest
+        # positive sampled density before normalising
+        pos = q[q > 0]
+        min_q = pos.min() if pos.size else 1.0
+        q = np.maximum(q, min_q)
+        w = (q / min_q) ** (-self.tree.is_exponent)
+        return idx, w
+
     # ------------------------------------------------------- priority update
     def update_priorities(self, idxes: np.ndarray, priorities: np.ndarray,
                           old_ptr: int, loss: float) -> None:
         """Write back learner priorities, discarding indices whose ring slots
-        were overwritten since the batch was sampled (worker.py:242-261)."""
+        were overwritten since the batch was sampled (worker.py:242-261).
+
+        The overwritten set is the interval [old_ptr, new_ptr) of the
+        LOGICAL ring walk (with wraparound); leaf indices are physical, so
+        they map back through :meth:`_log_block` first (identity for
+        G == 1, where this reduces to the reference's pointer arithmetic).
+        """
         K = self.cfg.seqs_per_block
         with self.lock:
             new_ptr = self.block_ptr
+            n = self._log_block(idxes // K)
             if new_ptr > old_ptr:
-                mask = (idxes < old_ptr * K) | (idxes >= new_ptr * K)
+                mask = (n < old_ptr) | (n >= new_ptr)
             elif new_ptr < old_ptr:
-                mask = (idxes < old_ptr * K) & (idxes >= new_ptr * K)
+                mask = (n < old_ptr) & (n >= new_ptr)
             else:
                 mask = np.ones_like(idxes, dtype=bool)
             self.tree.update(idxes[mask], priorities[mask])

@@ -93,11 +93,9 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                       start_minutes=start_minutes)
     ring = None
     if cfg.device_replay and jax.process_count() == 1:
-        from r2d2_tpu.parallel.mesh import replicated
-        from r2d2_tpu.replay.device_ring import DeviceRing
+        from r2d2_tpu.replay.device_ring import DeviceRing, resolve_layout
         from r2d2_tpu.replay.replay_buffer import data_bytes
 
-        # the ring is replicated under a mesh, so the budget is per-device
         need, cap = data_bytes(cfg, action_dim), _device_memory_bytes()
         if cap is None:
             # backend exposes no memory stats (e.g. the CPU client):
@@ -105,17 +103,21 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
             from r2d2_tpu.replay.replay_buffer import _available_host_bytes
 
             cap = _available_host_bytes()
-        if cap is not None and need > 0.8 * cap:
+        # "auto" shards the slot axis over dp when the ring outgrows one
+        # device's HBM; the guard below then checks the per-device share
+        layout = resolve_layout(cfg, mesh, need, cap)
+        per_device = need // (mesh.shape["dp"] if layout == "dp" else 1)
+        if cap is not None and per_device > 0.8 * cap:
             import warnings
 
             warnings.warn(
-                f"device_replay ring needs {need / 1e9:.1f} GB but the "
-                f"device has {cap / 1e9:.1f} GB; falling back to host "
-                "replay — reduce buffer_capacity to fit", stacklevel=2)
+                f"device_replay ring needs {per_device / 1e9:.1f} GB per "
+                f"device (layout={layout}) but the device has "
+                f"{cap / 1e9:.1f} GB; falling back to host replay — "
+                "reduce buffer_capacity to fit", stacklevel=2)
         else:
-            ring = DeviceRing(
-                cfg, action_dim,
-                placement=replicated(mesh) if mesh is not None else None)
+            ring = (DeviceRing(cfg, action_dim, mesh=mesh, layout=layout)
+                    if mesh is not None else DeviceRing(cfg, action_dim))
     elif cfg.device_replay:
         import warnings
 

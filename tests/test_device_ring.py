@@ -229,6 +229,218 @@ def test_train_end_to_end_device_replay_under_mesh():
     assert not metrics["fabric_failed"]
 
 
+# ---------------------------------------------------------------------------
+# dp-sharded ring layout: capacity scales with the mesh
+# ---------------------------------------------------------------------------
+
+def dp_buffers(cfg, mesh, n_blocks, seed=0, layout="dp"):
+    ring = DeviceRing(cfg, A, mesh=mesh, layout=layout)
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(99),
+                       device_ring=ring)
+    for blk, prios in scripted_blocks(cfg, n_blocks, seed):
+        buf.add(blk, prios, None)
+    return buf, ring
+
+
+def test_dp_ring_round_robin_fill():
+    """Logical FIFO positions land round-robin across the group slabs, so
+    every dp group has data after the first G blocks."""
+    from r2d2_tpu.parallel.mesh import make_mesh
+
+    cfg = make_cfg(mesh_shape=(("dp", 4),))
+    mesh = make_mesh(cfg)
+    buf, ring = dp_buffers(cfg, mesh, n_blocks=4)
+    bpg = ring.blocks_per_group
+    assert ring.num_groups == buf.G == 4
+    # block n → slot (n % 4)·bpg + n//4: first block of each slab occupied
+    for g in range(4):
+        assert buf.block_learning_total[g * bpg] > 0
+        assert buf.block_learning_total[g * bpg + 1] == 0
+    # bijection over the whole ring
+    n = np.arange(cfg.num_blocks)
+    assert np.array_equal(buf._log_block(buf._phys_block(n)), n)
+    assert sorted(buf._phys_block(n)) == list(n)
+
+
+def test_dp_sample_meta_rows_stay_in_own_group():
+    """Row chunk g of every sampled bundle must reference only group g's
+    slot slab — the precondition for the collective-free shard_map
+    gather."""
+    from r2d2_tpu.parallel.mesh import make_mesh
+
+    cfg = make_cfg(mesh_shape=(("dp", 4),))
+    mesh = make_mesh(cfg)
+    buf, ring = dp_buffers(cfg, mesh, n_blocks=8)
+    B, G = cfg.batch_size, 4
+    meta = buf.sample_meta(k=3, batch_size=B)
+    per, bpg = B // G, ring.blocks_per_group
+    for j in range(3):
+        blocks = meta["ints"][j, :, 0]
+        for g in range(G):
+            rows = blocks[g * per:(g + 1) * per]
+            assert np.all((rows >= g * bpg) & (rows < (g + 1) * bpg)), (
+                f"bundle {j} group {g} rows {rows} escaped slab")
+
+
+def test_dp_sample_meta_rejects_indivisible_batch():
+    from r2d2_tpu.parallel.mesh import make_mesh
+
+    cfg = make_cfg(mesh_shape=(("dp", 4),))
+    buf, _ = dp_buffers(cfg, make_mesh(cfg), n_blocks=4)
+    with pytest.raises(ValueError, match="divisible"):
+        buf.sample_meta(k=1, batch_size=6)
+
+
+def test_dp_sharded_super_step_matches_single_device():
+    """The dp-sharded data plane (slot-sharded ring, shard_map gather) must
+    reproduce the single-device super-step on the same index bundles —
+    only the byte placement changes, never the math."""
+    from r2d2_tpu.parallel.mesh import (
+        make_mesh, replicate_state, sharded_super_step)
+
+    cfg = make_cfg(mesh_shape=(("dp", 4), ("mp", 2)))
+    mesh = make_mesh(cfg)
+    k = 2
+    buf, ring = dp_buffers(cfg, mesh, n_blocks=6)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(7))
+    meta = buf.sample_meta(k=k, batch_size=cfg.batch_size)
+
+    # single-device reference on the same physical slot arrangement
+    arrays_host = {kk: np.asarray(jax.device_get(v))
+                   for kk, v in ring.snapshot().items()}
+    state_a = create_train_state(cfg, params)
+    super_a = make_super_step(cfg, net, k)
+    state_a, losses_a, prios_a = super_a(
+        state_a, {kk: jnp.asarray(v) for kk, v in arrays_host.items()},
+        jnp.asarray(meta["ints"]), jnp.asarray(meta["is_weights"]))
+
+    state_b = create_train_state(cfg, params)
+    super_b = sharded_super_step(cfg, net, mesh, k,
+                                 state_template=state_b, layout="dp")
+    state_b = replicate_state(mesh, state_b)
+    state_b, losses_b, prios_b = super_b(state_b, ring.snapshot(),
+                                         jnp.asarray(meta["ints"]),
+                                         jnp.asarray(meta["is_weights"]))
+
+    np.testing.assert_allclose(np.asarray(losses_b), np.asarray(losses_a),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(prios_b), np.asarray(prios_a),
+                               rtol=1e-5, atol=1e-6)
+    for pa, pb in zip(jax.tree.leaves(state_a.params),
+                      jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(pb), np.asarray(pa),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_dp_stale_priority_masking_uses_logical_walk():
+    """Feedback for slots overwritten since sampling must be dropped; with
+    G > 1 the overwritten set is an interval of the LOGICAL walk that maps
+    to non-contiguous physical slots."""
+    from r2d2_tpu.parallel.mesh import make_mesh
+
+    cfg = make_cfg(mesh_shape=(("dp", 2),))
+    mesh = make_mesh(cfg)
+    NB, K = cfg.num_blocks, cfg.seqs_per_block
+    buf, ring = dp_buffers(cfg, mesh, n_blocks=NB)  # full ring, ptr wraps to 0
+    assert buf.block_ptr == 0
+    old_ptr = buf.block_ptr
+
+    for blk, prios in scripted_blocks(cfg, 3, seed=5):
+        buf.add(blk, prios, None)  # overwrites logical 0,1,2
+    assert buf.block_ptr == 3
+
+    before = buf.tree.nodes[buf.tree.leaf_offset:
+                            buf.tree.leaf_offset + NB * K].copy()
+    idxes = np.arange(NB * K, dtype=np.int64)
+    buf.update_priorities(idxes, np.full(NB * K, 5.0), old_ptr, loss=0.0)
+    after = buf.tree.nodes[buf.tree.leaf_offset:
+                           buf.tree.leaf_offset + NB * K]
+
+    stale_slots = buf._phys_block(np.arange(3))           # logical 0,1,2
+    assert set(stale_slots) == {0, NB // 2, 1}            # non-contiguous
+    expected = 5.0 ** cfg.prio_exponent
+    for slot in range(NB):
+        leaves = slice(slot * K, (slot + 1) * K)
+        if slot in stale_slots:
+            np.testing.assert_array_equal(after[leaves], before[leaves])
+        else:
+            np.testing.assert_allclose(after[leaves], expected, rtol=1e-12)
+
+
+def test_dp_is_weights_use_per_group_densities():
+    """IS weights must correct for the realised inclusion probabilities:
+    prio/mass_of_own_group, min-normalised across the whole batch."""
+    from r2d2_tpu.parallel.mesh import make_mesh
+
+    cfg = make_cfg(mesh_shape=(("dp", 2),))
+    mesh = make_mesh(cfg)
+    ring = DeviceRing(cfg, A, mesh=mesh, layout="dp")
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(3),
+                       device_ring=ring)
+    blocks = scripted_blocks(cfg, 2)
+    K = cfg.seqs_per_block
+    buf.add(blocks[0][0], np.full(K, 1.0), None)   # → group 0
+    buf.add(blocks[1][0], np.full(K, 4.0), None)   # → group 1
+
+    meta = buf.sample_meta(k=1, batch_size=cfg.batch_size)
+    idx, w = meta["idxes"][0], meta["is_weights"][0]
+    leaf_prio = buf.tree.nodes[buf.tree.leaf_offset + idx]
+    span = (cfg.num_blocks // 2) * K
+    group = idx // span
+    mass = np.array([buf.tree.prefix_mass(span),
+                     buf.tree.prefix_mass(2 * span)
+                     - buf.tree.prefix_mass(span)])
+    q = leaf_prio / mass[group]
+    expected = (q / q.min()) ** (-cfg.importance_sampling_exponent)
+    np.testing.assert_allclose(w, expected, rtol=1e-6)
+    # higher-priority group-1 rows are down-weighted relative to group 0
+    assert w[group == 1].max() <= w[group == 0].min() + 1e-9
+
+
+def test_resolve_layout():
+    from r2d2_tpu.parallel.mesh import make_mesh
+    from r2d2_tpu.replay.device_ring import resolve_layout
+
+    cfg = make_cfg(mesh_shape=(("dp", 4),))
+    mesh = make_mesh(cfg)
+    GB = 10 ** 9
+    # auto: fits on one device → replicate; doesn't fit → shard
+    assert resolve_layout(cfg, mesh, GB, 16 * GB) == "replicated"
+    assert resolve_layout(cfg, mesh, 15 * GB, 16 * GB) == "dp"
+    # auto but shapes indivisible → stay replicated (guard falls back)
+    cfg_bad = make_cfg(mesh_shape=(("dp", 4),), batch_size=6)
+    assert resolve_layout(cfg_bad, mesh, 15 * GB, 16 * GB) == "replicated"
+    # explicit requests
+    assert resolve_layout(cfg.replace(device_ring_layout="replicated"),
+                          mesh, 15 * GB, 16 * GB) == "replicated"
+    assert resolve_layout(cfg.replace(device_ring_layout="dp"),
+                          mesh, GB, 16 * GB) == "dp"
+    with pytest.raises(ValueError, match="dp"):
+        resolve_layout(cfg_bad.replace(device_ring_layout="dp"),
+                       mesh, GB, 16 * GB)
+    with pytest.raises(ValueError, match="mesh"):
+        resolve_layout(cfg.replace(device_ring_layout="dp"), None,
+                       GB, 16 * GB)
+
+
+def test_train_end_to_end_device_replay_dp_layout():
+    """Full fabric on the dp-sharded device data plane."""
+    from r2d2_tpu.train import train
+
+    cfg = make_cfg(game_name="Fake", device_replay=True, superstep_k=2,
+                   training_steps=6, log_interval=0.2,
+                   mesh_shape=(("dp", 4),), device_ring_layout="dp")
+    metrics = train(
+        cfg,
+        env_factory=lambda c, seed: FakeAtariEnv(
+            obs_shape=c.stored_obs_shape, action_dim=A, seed=seed),
+        use_mesh=True, verbose=False)
+    assert metrics["num_updates"] >= cfg.training_steps
+    assert np.isfinite(metrics["mean_loss"])
+    assert not metrics["fabric_failed"]
+
+
 def test_run_device_cadences_and_drain(tmp_path):
     """run_device must fire weight publication and checkpoint cadences on
     interval crossings even when k doesn't divide them, and harvest the
