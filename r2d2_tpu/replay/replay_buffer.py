@@ -389,8 +389,7 @@ class ReplayBuffer:
         idx_parts, q_parts = [], []
         for g in range(self.G):
             lo, hi = g * span, (g + 1) * span
-            part, prios = self.tree.sample_range(per, lo, hi)
-            mass = self.tree.prefix_mass(hi) - self.tree.prefix_mass(lo)
+            part, prios, mass = self.tree.sample_range(per, lo, hi)
             idx_parts.append(part)
             q_parts.append(prios / mass)
         idx = np.concatenate(idx_parts)

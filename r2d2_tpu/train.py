@@ -96,17 +96,28 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
         from r2d2_tpu.replay.device_ring import DeviceRing, resolve_layout
         from r2d2_tpu.replay.replay_buffer import data_bytes
 
-        need, cap = data_bytes(cfg, action_dim), _device_memory_bytes()
-        if cap is None:
+        need, dev_cap = data_bytes(cfg, action_dim), _device_memory_bytes()
+        if dev_cap is not None:
+            cap = dev_cap
+        else:
             # backend exposes no memory stats (e.g. the CPU client):
             # "device" memory IS host memory, so apply the host guard
             from r2d2_tpu.replay.replay_buffer import _available_host_bytes
 
             cap = _available_host_bytes()
         # "auto" shards the slot axis over dp when the ring outgrows one
-        # device's HBM; the guard below then checks the per-device share
-        layout = resolve_layout(cfg, mesh, need, cap)
-        per_device = need // (mesh.shape["dp"] if layout == "dp" else 1)
+        # device's HBM; the guard below then checks the per-device share.
+        # Only genuine per-device stats may trigger auto-sharding: on a
+        # host-RAM fallback cap every "device" shares one memory, so
+        # splitting the accounting per device would wave through a ring
+        # the host cannot hold (an explicit 'dp' request still honours the
+        # user's judgement).
+        layout = resolve_layout(cfg, mesh, need,
+                                dev_cap if dev_cap is not None else None)
+        # budget per real device; against a host-RAM fallback cap the
+        # shards share one memory, so the whole ring is the burden
+        per_device = (need // (mesh.shape["dp"] if layout == "dp" else 1)
+                      if dev_cap is not None else need)
         if cap is not None and per_device > 0.8 * cap:
             import warnings
 

@@ -1,0 +1,45 @@
+"""bench.py failure isolation: the headline learner metric must survive a
+crash in the actor/system phases (the driver records the one JSON line as
+the round artifact — a late-phase crash must not zero it)."""
+import json
+import sys
+
+import numpy as np
+
+
+def test_bench_main_survives_actor_and_system_crash(monkeypatch, capsys):
+    from r2d2_tpu import bench
+
+    monkeypatch.setattr(bench, "_learner_micro_bench",
+                        lambda steps, warmup: (123456.0, 42.0, 1e9))
+
+    def boom(*a, **k):
+        raise RuntimeError("injected bench fault")
+
+    monkeypatch.setattr(bench, "_actor_plane_bench", boom)
+    monkeypatch.setattr(bench, "_system_bench", boom)
+
+    bench.main(steps=1, warmup=0, system_seconds=0.1)
+    out = capsys.readouterr().out.strip().splitlines()
+    result = json.loads(out[0])
+    assert result["metric"] == "learner_env_frames_per_sec"
+    assert result["value"] == 123456.0
+    assert result["vs_baseline"] == round(123456.0 / bench.NORTH_STAR_FPS, 3)
+    assert result["actor_env_frames_per_sec"] == -1.0
+    assert result["system_env_frames_per_sec"] == -1.0
+
+
+def test_bench_json_line_is_first_stdout_line(monkeypatch, capsys):
+    """The driver parses stdout for ONE JSON line; nothing may precede it."""
+    from r2d2_tpu import bench
+
+    monkeypatch.setattr(bench, "_learner_micro_bench",
+                        lambda steps, warmup: (50000.0, 10.0, 0.0))
+    monkeypatch.setattr(bench, "_actor_plane_bench", lambda: 1.0)
+    monkeypatch.setattr(bench, "_system_bench",
+                        lambda s: (2.0, {}, 3))
+    bench.main(steps=1, warmup=0, system_seconds=0.1)
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    parsed = json.loads(lines[0])
+    assert parsed["vs_baseline"] == 1.0
+    assert np.isclose(parsed["system_env_frames_per_sec"], 2.0)

@@ -380,8 +380,12 @@ def test_dp_is_weights_use_per_group_densities():
                        device_ring=ring)
     blocks = scripted_blocks(cfg, 2)
     K = cfg.seqs_per_block
-    buf.add(blocks[0][0], np.full(K, 1.0), None)   # → group 0
-    buf.add(blocks[1][0], np.full(K, 4.0), None)   # → group 1
+    assert K == 2
+    # non-uniform priorities WITHIN each group so densities (and therefore
+    # weights) actually vary — uniform priorities would make every weight
+    # exactly 1.0 and the assertions vacuous
+    buf.add(blocks[0][0], np.array([1.0, 3.0]), None)    # → group 0
+    buf.add(blocks[1][0], np.array([4.0, 12.0]), None)   # → group 1
 
     meta = buf.sample_meta(k=1, batch_size=cfg.batch_size)
     idx, w = meta["idxes"][0], meta["is_weights"][0]
@@ -394,8 +398,13 @@ def test_dp_is_weights_use_per_group_densities():
     q = leaf_prio / mass[group]
     expected = (q / q.min()) ** (-cfg.importance_sampling_exponent)
     np.testing.assert_allclose(w, expected, rtol=1e-6)
-    # higher-priority group-1 rows are down-weighted relative to group 0
-    assert w[group == 1].max() <= w[group == 0].min() + 1e-9
+    assert w.min() < 1.0 - 1e-6 and w.max() == pytest.approx(1.0)
+    # group 1's priorities are group 0's scaled by 4, so the per-group
+    # normalisation must cancel the scale: both groups produce the SAME
+    # density set {1^α/m0, 3^α/m0} — the cross-group fairness property
+    q0 = np.unique(np.round(q[group == 0], 12))
+    q1 = np.unique(np.round(q[group == 1], 12))
+    assert np.intersect1d(q0, q1).size > 0
 
 
 def test_resolve_layout():
@@ -436,6 +445,32 @@ def test_train_end_to_end_device_replay_dp_layout():
         env_factory=lambda c, seed: FakeAtariEnv(
             obs_shape=c.stored_obs_shape, action_dim=A, seed=seed),
         use_mesh=True, verbose=False)
+    assert metrics["num_updates"] >= cfg.training_steps
+    assert np.isfinite(metrics["mean_loss"])
+    assert not metrics["fabric_failed"]
+
+
+def test_device_replay_falls_back_to_host_when_ring_too_big(monkeypatch):
+    """The capacity guard must degrade to host replay with a warning, not
+    crash or silently OOM, when the ring exceeds the device budget."""
+    import sys
+    import warnings
+
+    import r2d2_tpu.train  # noqa: F401 — ensure the module is loaded
+    train_mod = sys.modules["r2d2_tpu.train"]
+
+    monkeypatch.setattr(train_mod, "_device_memory_bytes", lambda: 1024)
+    cfg = make_cfg(game_name="Fake", device_replay=True, superstep_k=2,
+                   training_steps=4, log_interval=0.2)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        metrics = train_mod.train(
+            cfg,
+            env_factory=lambda c, seed: FakeAtariEnv(
+                obs_shape=c.stored_obs_shape, action_dim=A, seed=seed),
+            verbose=False)
+    assert any("falling back to host replay" in str(w.message)
+               for w in caught)
     assert metrics["num_updates"] >= cfg.training_steps
     assert np.isfinite(metrics["mean_loss"])
     assert not metrics["fabric_failed"]

@@ -85,9 +85,11 @@ def test_sample_range_stays_in_range_and_is_proportional():
 
     lo, hi = 16, 48
     counts = np.zeros(64)
+    expected_mass = float(prios[lo:hi].sum())
     for _ in range(300):
-        idx, p = tree.sample_range(8, lo, hi)
+        idx, p, mass = tree.sample_range(8, lo, hi)
         assert ((idx >= lo) & (idx < hi)).all()
+        assert mass == pytest.approx(expected_mass, rel=1e-12)
         np.testing.assert_allclose(
             p, tree.nodes[idx + tree.leaf_offset], rtol=1e-12)
         np.testing.assert_array_equal(np.sort(idx), idx)  # stratified order

@@ -55,6 +55,48 @@ def test_train_threaded_fabric():
     assert len(m["logs"]) > 0  # stats loop produced entries
 
 
+class _FlakyEnv:
+    """FakeAtariEnv that raises once, `fail_at` steps in — fabric-level
+    fault injection (SURVEY §5.3: the reference has none; a dead actor
+    silently starves its queue)."""
+
+    def __init__(self, cfg, seed, fail_at):
+        self._env = FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A,
+                                 seed=seed, episode_len=32)
+        self.action_space = self._env.action_space
+        self._steps = 0
+        self._fail_at = fail_at
+        self._failed = False
+
+    def reset(self, **kw):
+        return self._env.reset(**kw)
+
+    def step(self, a):
+        self._steps += 1
+        if not self._failed and self._steps >= self._fail_at:
+            self._failed = True
+            raise RuntimeError("injected env fault")
+        return self._env.step(a)
+
+
+def test_fabric_recovers_from_actor_crash():
+    """An env exception kills the actor thread mid-run; the Supervisor must
+    restart it (crash recorded in health) and the run must still complete
+    every training step."""
+    cfg = make_test_config(game_name="Fake", training_steps=30,
+                           prefetch_batches=2, log_interval=0.5)
+    # fail after the buffer has data but well before the run can finish
+    m = train(cfg,
+              env_factory=lambda c, seed: _FlakyEnv(c, seed, fail_at=300),
+              max_wall_seconds=120, verbose=False)
+    assert m["num_updates"] == 30
+    assert not m["fabric_failed"]
+    health = m["health"]["actor"]
+    assert health["restarts"] >= 1 and not health["gave_up"]
+    assert "injected env fault" in health["last_error"]
+    assert np.isfinite(m["mean_loss"])
+
+
 def _scripted_batches(cfg, n, seed=0):
     rng = np.random.default_rng(seed)
     B, T, L = cfg.batch_size, cfg.seq_len, cfg.learning_steps
