@@ -204,6 +204,12 @@ class VectorActor:
                 # actor_update_interval steps) replaces a round trip per
                 # env step.
                 params = jax.device_put(params, self._act_device)
+            elif isinstance(
+                    jax.tree.leaves(params)[0], np.ndarray):
+                # multi-host publishes HOST arrays (learner._publish) so
+                # actor jits stay process-local; commit them to one local
+                # device per refresh rather than re-uploading every call
+                params = jax.device_put(params, jax.local_devices()[0])
             self._params = params
             self._param_version = version
 

@@ -59,6 +59,7 @@ class Learner:
         self.checkpointer = checkpointer
         self.env_steps = start_env_steps
         self.start_minutes = start_minutes
+        self._replicate_params = None  # lazily-built multihost resharder
 
         if mesh is not None:
             self._step_fn = sharded_train_step(cfg, net, mesh,
@@ -77,8 +78,32 @@ class Learner:
         # deep-copy: the jitted step donates the state, so a published
         # snapshot must not alias state buffers or the next update would
         # delete it out from under the actors
-        self.param_store.publish(
-            jax.tree.map(jnp.copy, self.state.params))
+        if jax.process_count() > 1 and self.mesh is not None:
+            # Multi-host: the state lives on the GLOBAL mesh, and any jit
+            # on global arrays is an SPMD launch every process must make
+            # in lockstep.  The actor thread consumes published params at
+            # arbitrary times, so handing it global arrays would let it
+            # issue unsynchronised collective launches that corrupt the
+            # collective stream (observed as a pod-wide deadlock in the
+            # learner's own allgathers).  Publish HOST arrays instead:
+            # reshard to replicated in-graph (a lockstep collective, made
+            # here on the learner thread — mp-sharded leaves live on
+            # other hosts) and fetch; actors then re-commit them to a
+            # local device and their inference jits stay process-local.
+            if self._replicate_params is None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                # built once: a fresh jit per publish would re-trace (and
+                # without a compile cache, re-compile) the reshard program
+                # on the learner hot loop every publish
+                self._replicate_params = jax.jit(lambda p: p,
+                                                 out_shardings=rep)
+            self.param_store.publish(jax.device_get(
+                self._replicate_params(self.state.params)))
+        else:
+            self.param_store.publish(
+                jax.tree.map(jnp.copy, self.state.params))
 
     @property
     def num_updates(self) -> int:
@@ -269,20 +294,25 @@ class Learner:
         ``cfg.device_replay`` — batch bytes never cross the host↔device
         boundary, so throughput is immune to interconnect latency (the
         reference's `.to(device)` per step, worker.py:330-342, is the cost
-        this removes).  Single-process only; multi-host runs use
-        :meth:`run` (each host's ring would hold different data).
+        this removes).
 
         The update counter advances by k per dispatch, so the loop may
         overshoot ``training_steps`` by up to k-1 updates.
 
-        Under a mesh (single process): the ring is mesh-replicated and the
-        super-step is GSPMD-sharded (parallel.mesh.sharded_super_step) —
-        index bundles shard their batch axis over dp, grads psum over ICI.
+        Under a mesh (single process): the ring is mesh-replicated (or
+        dp-sharded, ``ring.layout``) and the super-step is GSPMD-sharded
+        (parallel.mesh.sharded_super_step) — index bundles shard their
+        batch axis over dp, grads psum over ICI.
+
+        Multi-host: dispatches to :meth:`_run_device_multihost` — each
+        host owns the slot slabs of its dp groups (a dp-layout ring over
+        its *local* submesh) and the global ring view is stitched from
+        the per-host device shards with zero data movement.
         """
         cfg = self.cfg
-        assert jax.process_count() == 1, (
-            "device_replay is per-process; multi-host runs use host "
-            "staging (Learner.run)")
+        if jax.process_count() > 1:
+            return self._run_device_multihost(buffer, ring, priority_sink,
+                                              max_steps, stop, tracer)
         if tracer is None:
             from r2d2_tpu.utils.trace import Tracer
             tracer = Tracer()
@@ -324,42 +354,69 @@ class Learner:
                 # one D2H round trip for everything the host needs
                 flat = np.asarray(jax.device_get(
                     jnp.concatenate([losses, priorities.reshape(-1)])))
-            losses_np, prios_np = flat[:k], flat[k:].reshape(k, B)
-            assert np.isfinite(losses_np).all(), (
-                f"non-finite loss in super-step: {losses_np}")
-            self.env_steps = int(meta["env_steps"])
-            if priority_sink is not None:
-                for j in range(k):
-                    priority_sink(meta["idxes"][j], prios_np[j],
-                                  meta["block_ptr"], float(losses_np[j]))
-            losses_hist.extend(losses_np.tolist())
+            self._feed_back(meta, flat[:k], flat[k:].reshape(k, B),
+                            priority_sink, losses_hist)
 
-        # depth-1 pipeline: dispatch super-step t+1 before syncing t's
-        # results, so the D2H round trip rides under the device compute.
-        # Priority feedback lags ≤ 2k updates — comparable to the
-        # reference's 8-batch queue + 4-batch staging lag.
+        def gate() -> str:
+            if stop is not None and stop():
+                return "break"
+            return "go" if buffer.ready else "wait"
+
+        def dispatch(ints, weights):
+            with tracer.span("learner.step_dispatch"):
+                return compiled(self.state, ring.snapshot(),
+                                jnp.asarray(ints), jnp.asarray(weights))
+
+        def sample():
+            with tracer.span("learner.sample_meta"):
+                return buffer.sample_meta(k, dispatch=dispatch)
+
+        self._superstep_loop(k, target, t0, gate, sample, harvest)
+
+        if self.checkpointer is not None:
+            self._save(self.num_updates, t0)
+        mins = self.start_minutes + (time.time() - t0) / 60.0
+        return dict(
+            num_updates=self.num_updates,
+            env_steps=self.env_steps,
+            minutes=mins,
+            mean_loss=(float(np.mean(losses_hist[-100:]))
+                       if losses_hist else float("nan")),
+        )
+
+    def _superstep_loop(self, k: int, target: int, t0: float,
+                        gate: Callable[[], str],
+                        sample: Callable[[], Dict[str, Any]],
+                        harvest: Callable[[Any], None]) -> None:
+        """The depth-1 pipelined super-step driver shared by the
+        single-process and multi-host device-replay paths: dispatch
+        super-step t+1 before syncing t's results, so the D2H round trip
+        rides under the device compute (priority feedback lags ≤ 2k
+        updates — comparable to the reference's 8-batch queue + 4-batch
+        staging lag, worker.py:300-316).  Cadences fire on interval
+        crossings (updates advance by k per dispatch).
+
+        ``gate()`` → "break" | "wait" | "go" decides each iteration;
+        ``sample()`` must return a meta dict whose ``dispatched`` holds
+        the in-flight (state, losses, priorities).
+        """
+        cfg = self.cfg
+        updates = self.num_updates
         pending = None
         while updates < target:
-            if stop is not None and stop():
+            g = gate()
+            if g == "break":
                 break
-            if not buffer.ready:
+            if g == "wait":
                 time.sleep(0.02)
                 continue
-
-            def dispatch(ints, weights):
-                with tracer.span("learner.step_dispatch"):
-                    return compiled(self.state, ring.snapshot(),
-                                    jnp.asarray(ints), jnp.asarray(weights))
-
-            with tracer.span("learner.sample_meta"):
-                meta = buffer.sample_meta(k, dispatch=dispatch)
+            meta = sample()
             self.state, losses, priorities = meta["dispatched"]
             if pending is not None:
                 harvest(pending)
             pending = (meta, losses, priorities)
 
             prev, updates = updates, updates + k
-            # cadences fire on interval crossings (updates advances by k)
             if (self.param_store is not None
                     and updates // cfg.weight_publish_interval
                     > prev // cfg.weight_publish_interval):
@@ -371,9 +428,163 @@ class Learner:
         if pending is not None:
             harvest(pending)
 
+    def _feed_back(self, meta, losses_np: np.ndarray, prios_np: np.ndarray,
+                   priority_sink: Optional[PrioritySink],
+                   losses_hist: list) -> None:
+        """Route one harvested super-step's results to the host side."""
+        assert np.isfinite(losses_np).all(), (
+            f"non-finite loss in super-step: {losses_np}")
+        self.env_steps = int(meta["env_steps"])
+        if priority_sink is not None:
+            for j in range(losses_np.shape[0]):
+                priority_sink(meta["idxes"][j], prios_np[j],
+                              meta["block_ptr"], float(losses_np[j]))
+        losses_hist.extend(losses_np.tolist())
+
+    def _run_device_multihost(self, buffer: Any, ring: Any,
+                              priority_sink: Optional[PrioritySink],
+                              max_steps: Optional[int],
+                              stop: Optional[Callable[[], bool]],
+                              tracer: Optional[Any]) -> Dict[str, float]:
+        """Device-resident replay across hosts — the pod-scale data plane.
+
+        Layout: the global ring's slot axis is the concatenation of every
+        host's slabs.  Host h's ReplayBuffer/DeviceRing (built over its
+        *local* submesh, layout="dp") owns the dp groups its devices hold;
+        its writes and sampling are process-local.  Per super-step, every
+        host:
+
+        1. agrees the fleet is ready / not stopped (sync_counter — the
+           dispatch below is a lockstep SPMD launch, so the decision to
+           make it must be collective);
+        2. samples its rows (raw per-group inclusion densities), agrees
+           the global min density (sync_min_array) so IS weights keep the
+           reference's min-of-the-whole-batch normalisation across the
+           pod, offsets its slot indices into global coordinates, and
+           uploads its rows of the (k, B, 6) bundle;
+        3. stitches the global ring view from the per-host device shards
+           (assemble_global — metadata only, no data movement) and
+           dispatches the SAME sharded super-step as the single-process
+           dp layout;
+        4. harvests its dp rows of the priorities (local_rows axis=1) and
+           feeds its own buffer — feedback never crosses hosts.
+
+        Batch bytes never touch host RAM, and never cross DCN: each
+        device gathers from its local slab inside shard_map; only grad
+        psums (ICI/DCN) and the tiny index/min-density collectives leave
+        the host.  Steps 2-3 run under the buffer lock (the device_ring
+        concurrency contract: a ring write donates the buffers a pending
+        dispatch would read).
+        """
+        import jax.numpy as _jnp
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from r2d2_tpu.parallel.distributed import (
+            assemble_global, global_from_local_rows, host_batch_size,
+            local_rows, owned_dp_groups, sync_counter, sync_min_array)
+        from r2d2_tpu.parallel.mesh import sharded_super_step
+        from r2d2_tpu.replay.device_ring import ring_sharding
+
+        cfg = self.cfg
+        assert self.mesh is not None, "multi-host device replay needs a mesh"
+        if tracer is None:
+            from r2d2_tpu.utils.trace import Tracer
+            tracer = Tracer()
+
+        k = cfg.superstep_k
+        t0 = time.time()
+        updates = self.num_updates
+        target = (cfg.training_steps if max_steps is None
+                  else updates + max_steps)
+
+        dp_local = ring.num_groups
+        bpg = ring.blocks_per_group
+        owned = owned_dp_groups(self.mesh)
+        if owned.stop - owned.start != dp_local:
+            raise RuntimeError(
+                f"ring has {dp_local} local groups but this process owns "
+                f"{owned.stop - owned.start} dp groups of the global mesh")
+        slot_offset = owned.start * bpg
+        global_blocks = self.mesh.shape["dp"] * bpg
+        B, B_host = cfg.batch_size, host_batch_size(cfg, self.mesh)
+        beta = cfg.importance_sampling_exponent
+
+        super_fn = sharded_super_step(cfg, self.net, self.mesh, k,
+                                      state_template=self.state,
+                                      layout="dp", blocks_per_group=bpg)
+        ring_sh = ring_sharding(self.mesh, "dp")
+        dp_b = NamedSharding(self.mesh, P(None, "dp"))
+        try:
+            # AOT with shape specs — the global ring is far too big to
+            # zero-fill host-side just to trace
+            ring_spec = {
+                kk: jax.ShapeDtypeStruct((global_blocks, *v.shape[1:]),
+                                         v.dtype, sharding=ring_sh[kk])
+                for kk, v in ring.snapshot().items()}
+            super_fn = super_fn.lower(
+                self.state, ring_spec,
+                jax.ShapeDtypeStruct((k, B, 6), _jnp.int32, sharding=dp_b),
+                jax.ShapeDtypeStruct((k, B), _jnp.float32, sharding=dp_b),
+            ).compile()
+        except Exception:
+            pass  # backend without AOT: first dispatch compiles
+        compiled = super_fn
+
+        losses_hist = []
+
+        def harvest(item) -> None:
+            meta, losses, priorities = item
+            with tracer.span("learner.result_sync"):
+                losses_np = np.asarray(jax.device_get(losses))  # replicated
+                prios_np = local_rows(priorities, axis=1)       # (k, B_host)
+            self._feed_back(meta, losses_np, prios_np, priority_sink,
+                            losses_hist)
+
+        def gate() -> str:
+            # collective decisions: the dispatch below is an SPMD launch
+            # every process must make together.  One allgather carries
+            # both flags (min-reduced, so "stop" travels inverted).
+            flags = sync_min_array(np.array([
+                0.0 if (stop is not None and stop()) else 1.0,
+                1.0 if buffer.ready else 0.0,
+            ]))
+            if flags[0] == 0.0:   # some host wants to stop
+                return "break"
+            if flags[1] == 0.0:   # some host's buffer not ready
+                return "wait"
+            return "go"
+
+        def dispatch(ints, q):
+            """Runs under the buffer lock (sample_meta couples sampling
+            with dispatch).  All hosts execute this in lockstep."""
+            with tracer.span("learner.step_dispatch"):
+                gmin = sync_min_array(q.min(axis=1))           # (k,)
+                w = (q / gmin[:, None]) ** (-beta)
+                g_ints = ints.astype(np.int32, copy=True)
+                g_ints[:, :, 0] += slot_offset
+                g_ints = global_from_local_rows(
+                    dp_b, g_ints, (k, B, 6), axis=1,
+                    offset=owned.start * (B // self.mesh.shape["dp"]))
+                g_w = global_from_local_rows(
+                    dp_b, w.astype(np.float32), (k, B), axis=1,
+                    offset=owned.start * (B // self.mesh.shape["dp"]))
+                ring_view = assemble_global(ring_sh, ring.snapshot(),
+                                            global_blocks)
+                return compiled(self.state, ring_view, g_ints, g_w)
+
+        def sample():
+            with tracer.span("learner.sample_meta"):
+                return buffer.sample_meta(k, batch_size=B_host,
+                                          dispatch=dispatch,
+                                          raw_densities=True)
+
+        self._superstep_loop(k, target, t0, gate, sample, harvest)
+
         if self.checkpointer is not None:
             self._save(self.num_updates, t0)
         mins = self.start_minutes + (time.time() - t0) / 60.0
+        self.env_steps = sync_counter(self.env_steps, reduce="sum")
         return dict(
             num_updates=self.num_updates,
             env_steps=self.env_steps,

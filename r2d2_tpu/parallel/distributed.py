@@ -91,13 +91,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
                 process_count=jax.process_count())
 
 
-def dp_rows_for_process(mesh: Mesh, global_batch: int) -> slice:
-    """The contiguous slice of the global batch this process's devices own.
+def owned_dp_groups(mesh: Mesh) -> slice:
+    """The contiguous range of dp groups whose devices this process owns.
 
-    Rows are sharded over the ``dp`` axis wherever it sits in the mesh; a
-    dp group's row-shard is replicated over the remaining axes.  Asserts
-    the topology assumption from the module docstring: this process's dp
-    groups are whole (all-local or all-remote) and contiguous.
+    Raises (real errors, not asserts — this alignment is load-bearing for
+    priority/index pairing and must survive ``python -O``) when a dp group
+    is split across processes or this process's groups are
+    non-contiguous: the topology assumption from the module docstring.
     """
     axis = mesh.axis_names.index("dp")
     dp = mesh.shape["dp"]
@@ -106,8 +106,6 @@ def dp_rows_for_process(mesh: Mesh, global_batch: int) -> slice:
     owned = []
     for i in range(dp):
         n_local = sum(d.id in local_ids for d in groups[i])
-        # real errors, not asserts: this alignment is load-bearing for
-        # priority/index pairing and must survive python -O
         if n_local not in (0, groups.shape[1]):
             raise RuntimeError(
                 f"dp group {i} is split across processes; re-order mesh "
@@ -120,8 +118,52 @@ def dp_rows_for_process(mesh: Mesh, global_batch: int) -> slice:
         raise RuntimeError(
             f"process owns non-contiguous dp groups {owned}; re-order mesh "
             f"axes so each host's dp rows are contiguous")
-    per = global_batch // dp
-    return slice(owned[0] * per, (owned[-1] + 1) * per)
+    return slice(owned[0], owned[-1] + 1)
+
+
+def dp_rows_for_process(mesh: Mesh, global_batch: int) -> slice:
+    """The contiguous slice of the global batch this process's devices own.
+
+    Rows are sharded over the ``dp`` axis wherever it sits in the mesh; a
+    dp group's row-shard is replicated over the remaining axes.
+    """
+    owned = owned_dp_groups(mesh)
+    per = global_batch // mesh.shape["dp"]
+    return slice(owned.start * per, owned.stop * per)
+
+
+def local_mesh(mesh: Mesh) -> Mesh:
+    """This process's whole-dp-group submesh of ``mesh`` — the same axis
+    names and order, the dp extent reduced to the groups this process
+    owns.  Collectives/jits over it are process-local (no cross-host
+    lockstep needed), which is what lets each host run its own device-side
+    replay plane (gather/write) independently while the global train step
+    stays SPMD over the full mesh."""
+    owned = owned_dp_groups(mesh)
+    axis = mesh.axis_names.index("dp")
+    sub = np.moveaxis(np.moveaxis(mesh.devices, axis, 0)[owned], 0, axis)
+    return Mesh(sub, mesh.axis_names)
+
+
+def assemble_global(shardings: Dict[str, Any],
+                    local_arrays: Dict[str, jax.Array],
+                    global_leading: int) -> Dict[str, jax.Array]:
+    """Stitch per-process device-resident shards into global jax Arrays.
+
+    ``local_arrays[k]`` is this process's slab, laid out over
+    :func:`local_mesh` such that each local device already holds exactly
+    the rows the global sharding assigns it (same physical device, same
+    bytes — only the leading-axis coordinates differ by the process
+    offset).  ``jax.make_array_from_single_device_arrays`` then assembles
+    the global view with **zero data movement**: every process contributes
+    its addressable shards.  Single-process this is a relabeling no-op.
+    """
+    out = {}
+    for k, la in local_arrays.items():
+        gshape = (global_leading, *la.shape[1:])
+        out[k] = jax.make_array_from_single_device_arrays(
+            gshape, shardings[k], [s.data for s in la.addressable_shards])
+    return out
 
 
 def host_batch_size(cfg: Config, mesh: Mesh) -> int:
@@ -151,8 +193,8 @@ def host_local_batch(mesh: Mesh, local_batch: Dict[str, np.ndarray],
     }
 
 
-def local_rows(arr: jax.Array) -> np.ndarray:
-    """This process's rows of a leading-axis-sharded global array.
+def local_rows(arr: jax.Array, axis: int = 0) -> np.ndarray:
+    """This process's rows of an ``axis``-sharded global array.
 
     Reads only addressable shards (a multi-host ``device_get`` of the full
     array would fail), ordered by global row index and deduplicated (a
@@ -161,10 +203,35 @@ def local_rows(arr: jax.Array) -> np.ndarray:
     """
     rows: Dict[int, np.ndarray] = {}
     for shard in arr.addressable_shards:
-        start = shard.index[0].start or 0
+        start = shard.index[axis].start or 0
         if start not in rows:
             rows[start] = np.asarray(shard.data)
-    return np.concatenate([rows[s] for s in sorted(rows)], axis=0)
+    return np.concatenate([rows[s] for s in sorted(rows)], axis=axis)
+
+
+def global_from_local_rows(sharding: Any, local_data: np.ndarray,
+                           global_shape: tuple, axis: int,
+                           offset: int) -> jax.Array:
+    """Host data → globally sharded device array, when this process's
+    ``local_data`` covers global indices [offset, offset + local) of
+    ``axis`` (replicated over every other mesh axis).
+
+    The per-device H2D puts follow the sharding's own index map, so this
+    works for any axis position (``make_array_from_process_local_data``
+    only tiles the leading axis).  Used for the (k, B, 6) index bundles of
+    the multi-host device-replay plane, which shard axis 1.
+    """
+    idx_map = sharding.addressable_devices_indices_map(global_shape)
+    arrs = []
+    for dev, idx in idx_map.items():
+        sl = list(idx)
+        s = sl[axis]
+        start = (s.start or 0) - offset
+        stop = (global_shape[axis] if s.stop is None else s.stop) - offset
+        sl[axis] = slice(start, stop)
+        arrs.append(jax.device_put(local_data[tuple(sl)], dev))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, arrs)
 
 
 def sync_counter(value: int, reduce: str = "max") -> int:
@@ -177,4 +244,21 @@ def sync_counter(value: int, reduce: str = "max") -> int:
 
     vals = np.asarray(multihost_utils.process_allgather(
         np.asarray(value, np.int64)))
-    return int(vals.max() if reduce == "max" else vals.sum())
+    if reduce == "max":
+        return int(vals.max())
+    if reduce == "min":
+        return int(vals.min())
+    return int(vals.sum())
+
+
+def sync_min_array(values: np.ndarray) -> np.ndarray:
+    """Element-wise min of a small float array across processes (the
+    cross-host IS-weight normalisation for the multi-host device replay
+    plane).  Single-process identity."""
+    values = np.asarray(values, np.float64)
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(values)).min(axis=0)

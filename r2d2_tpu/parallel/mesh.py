@@ -183,7 +183,8 @@ def _mesh_net(cfg: Config, net: R2D2Network) -> R2D2Network:
 
 def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
                        state_template: Optional[TrainState] = None,
-                       layout: str = "replicated"):
+                       layout: str = "replicated",
+                       blocks_per_group: Optional[int] = None):
     """The device-replay super-step compiled over the mesh.
 
     The index bundles and is_weights shard their batch axis (axis 1) over
@@ -203,8 +204,11 @@ def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
       offset.  Still no collectives in the data plane; only the grad psum
       crosses ICI.
 
-    Single-process only (each host's ring holds its own buffer's data, so
-    a multi-host mesh cannot see one coherent ring) — the caller guards.
+    ``blocks_per_group`` defaults to ``cfg.num_blocks // dp``
+    (single-process, where cfg.num_blocks is the whole ring).  Multi-host
+    device replay passes it explicitly: there cfg.num_blocks is the
+    PER-HOST ring and the global slot axis is the concatenation of every
+    host's slabs (learner/learner.py).
     """
     dp = mesh.shape["dp"]
     if cfg.batch_size % dp != 0:
@@ -220,11 +224,12 @@ def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
     if layout == "dp":
         from jax import shard_map
 
-        if cfg.num_blocks % dp:
-            raise ValueError(
-                f"layout='dp' needs num_blocks ({cfg.num_blocks}) "
-                f"divisible by dp={dp}")
-        blocks_per_group = cfg.num_blocks // dp
+        if blocks_per_group is None:
+            if cfg.num_blocks % dp:
+                raise ValueError(
+                    f"layout='dp' needs num_blocks ({cfg.num_blocks}) "
+                    f"divisible by dp={dp}")
+            blocks_per_group = cfg.num_blocks // dp
 
         def local_gather(arrays, ints_t, w_t):
             gid = jax.lax.axis_index("dp")

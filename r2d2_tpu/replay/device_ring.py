@@ -34,8 +34,14 @@ Capacity envelope — two mesh layouts (``layout=``):
   the logical FIFO walk for stale-feedback masking.  The in-graph gather
   runs inside ``shard_map`` — each dp group reads only its local shard,
   no collectives (parallel.mesh.sharded_super_step(layout="dp")).
-  Multi-host meshes instead shard capacity per host (each host owns its
-  buffer; learner/learner.py uses host staging there).
+
+Multi-host meshes compose the same layout across processes: each host
+builds a dp ring over its LOCAL submesh (its dp groups' slabs) and fills
+it with its own actors' experience; the learner stitches the per-host
+device shards into the global ring view with zero data movement and
+dispatches the same sharded super-step in SPMD lockstep
+(``Learner._run_device_multihost``) — replay capacity scales with the
+pod, batch bytes never touch host RAM or DCN.
 
 CONCURRENCY CONTRACT: ``write`` and ``snapshot``+train-step-dispatch must
 be externally serialised (the ReplayBuffer's lock is the coordination

@@ -311,7 +311,8 @@ class ReplayBuffer:
 
     # ---------------------------------------------------------- sample (meta)
     def sample_meta(self, k: int, batch_size: Optional[int] = None,
-                    dispatch=None) -> Dict[str, np.ndarray]:
+                    dispatch=None,
+                    raw_densities: bool = False) -> Dict[str, np.ndarray]:
         """Sample ``k`` index bundles for the in-graph device gather
         (replay/device_ring.gather_batch) — the index arithmetic of
         ``sample_batch`` without touching any data array.
@@ -337,6 +338,12 @@ class ReplayBuffer:
         are ``(q/min_q)^-beta`` min-normalised across the WHOLE batch —
         the reference scheme applied to the true per-group probabilities.
 
+        ``raw_densities=True`` returns the inclusion densities q in the
+        ``is_weights`` slots instead of normalised weights — the
+        multi-host device-replay plane samples per host and normalises by
+        the min across ALL hosts' rows (learner/learner.py), keeping the
+        min-of-the-whole-batch scheme across the pod.
+
         Returns ints (k,B,6) i32 · is_weights (k,B) f32 · idxes (k,B) i64 ·
         block_ptr · env_steps.
         """
@@ -356,7 +363,9 @@ class ReplayBuffer:
                     "sample_meta on an empty buffer; wait for add() (use "
                     "`ready` to gate on learning_starts)")
             for j in range(k):
-                if self.G == 1:
+                if raw_densities:
+                    idx, w = self._grouped_densities(B)
+                elif self.G == 1:
                     idx, w = self.tree.sample(B)
                 else:
                     idx, w = self._sample_grouped(B)
@@ -379,10 +388,12 @@ class ReplayBuffer:
                 meta["dispatched"] = dispatch(ints, weights)
         return meta
 
-    def _sample_grouped(self, B: int) -> Tuple[np.ndarray, np.ndarray]:
-        """One B-row draw for a G-group ring: B/G rows per group slab,
-        IS weights from the per-group inclusion densities (caller holds
-        the lock)."""
+    def _grouped_densities(self, B: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One B-row draw (B/G rows per group slab) returning the raw
+        per-row inclusion densities prio/mass_group (caller holds the
+        lock).  Zero-density leaves (a descent landing on a zero leaf
+        through float error) are clamped to the smallest positive sampled
+        density, mirroring SumTree.sample's guard."""
         K = self.cfg.seqs_per_block
         span = self._blocks_per_group * K
         per = B // self.G
@@ -394,12 +405,15 @@ class ReplayBuffer:
             q_parts.append(prios / mass)
         idx = np.concatenate(idx_parts)
         q = np.concatenate(q_parts)
-        # zero-leaf guard, mirroring SumTree.sample: clamp to the smallest
-        # positive sampled density before normalising
         pos = q[q > 0]
-        min_q = pos.min() if pos.size else 1.0
-        q = np.maximum(q, min_q)
-        w = (q / min_q) ** (-self.tree.is_exponent)
+        q = np.maximum(q, pos.min() if pos.size else 1.0)
+        return idx, q
+
+    def _sample_grouped(self, B: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One B-row draw for a G-group ring with IS weights normalised by
+        the minimum sampled density (caller holds the lock)."""
+        idx, q = self._grouped_densities(B)
+        w = (q / q.min()) ** (-self.tree.is_exponent)
         return idx, w
 
     # ------------------------------------------------------- priority update

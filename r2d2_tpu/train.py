@@ -107,13 +107,12 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
             cap = _available_host_bytes()
         # "auto" shards the slot axis over dp when the ring outgrows one
         # device's HBM; the guard below then checks the per-device share.
-        # Only genuine per-device stats may trigger auto-sharding: on a
-        # host-RAM fallback cap every "device" shares one memory, so
-        # splitting the accounting per device would wave through a ring
-        # the host cannot hold (an explicit 'dp' request still honours the
-        # user's judgement).
-        layout = resolve_layout(cfg, mesh, need,
-                                dev_cap if dev_cap is not None else None)
+        # Only genuine per-device stats (dev_cap) may trigger
+        # auto-sharding: on a host-RAM fallback cap every "device" shares
+        # one memory, so splitting the accounting per device would wave
+        # through a ring the host cannot hold (an explicit 'dp' request
+        # still honours the user's judgement).
+        layout = resolve_layout(cfg, mesh, need, dev_cap)
         # budget per real device; against a host-RAM fallback cap the
         # shards share one memory, so the whole ring is the burden
         per_device = (need // (mesh.shape["dp"] if layout == "dp" else 1)
@@ -130,11 +129,45 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
             ring = (DeviceRing(cfg, action_dim, mesh=mesh, layout=layout)
                     if mesh is not None else DeviceRing(cfg, action_dim))
     elif cfg.device_replay:
+        # multi-host: each host owns the slot slabs of its dp groups — a
+        # dp-layout ring over its LOCAL submesh.  The learner stitches the
+        # global ring view per super-step (Learner._run_device_multihost).
         import warnings
 
-        warnings.warn(
-            "device_replay is per-process; this multi-host run uses host "
-            "staging instead", stacklevel=2)
+        if mesh is None or cfg.device_ring_layout == "replicated":
+            warnings.warn(
+                "multi-host device_replay needs the global mesh and a "
+                "sharded ring (device_ring_layout 'auto'/'dp'); using "
+                "host staging instead", stacklevel=2)
+        else:
+            from r2d2_tpu.parallel.distributed import local_mesh, sync_counter
+            from r2d2_tpu.replay.device_ring import DeviceRing
+            from r2d2_tpu.replay.replay_buffer import data_bytes
+
+            lmesh = local_mesh(mesh)
+            dp_local = lmesh.shape["dp"]
+            need, cap = data_bytes(cfg, action_dim), _device_memory_bytes()
+            shapes_ok = not (cfg.num_blocks % dp_local
+                             or cfg.batch_size % mesh.shape["dp"]
+                             or host_bs % dp_local)
+            fits = cap is None or need // dp_local <= 0.8 * cap
+            # COLLECTIVE decision: run_device's multi-host loop and run's
+            # host staging issue different collective sequences, so every
+            # process must pick the same path — one host failing its local
+            # guard (heterogeneous HBM headroom, uneven device counts)
+            # must push the whole pod to host staging, not deadlock it
+            ok = sync_counter(int(shapes_ok and fits), reduce="min") > 0
+            if ok:
+                ring = DeviceRing(cfg, action_dim, mesh=lmesh, layout="dp")
+            else:
+                warnings.warn(
+                    "multi-host device_replay disabled (on at least one "
+                    f"host): shapes_ok={shapes_ok} (num_blocks "
+                    f"{cfg.num_blocks} vs local dp {dp_local}, batch "
+                    f"{cfg.batch_size} vs dp {mesh.shape['dp']}), "
+                    f"fits={fits} (ring {need / dp_local / 1e9:.1f} GB "
+                    "per device); using host staging instead",
+                    stacklevel=2)
     buffer = ReplayBuffer(cfg, action_dim,
                           rng=np.random.default_rng(cfg.seed),
                           device_ring=ring)

@@ -1,0 +1,57 @@
+"""Worker for tests/test_multiprocess.py::test_two_process_full_train.
+
+Runs the FULL threaded trainer (actor fleet + replay + learner + logging
+under the Supervisor) with multi-host device replay in a 2-process JAX
+runtime.  This is the integration surface the learner-direct worker
+(_mp_worker.py) cannot cover: the actor thread consumes published params
+concurrently with the learner's collectives, which deadlocks the pod if
+any published leaf is a global-mesh array (regression: Learner._publish
+must hand actors process-local arrays).
+
+Usage: python _mp_train_worker.py <coordinator_port> <process_id> <out_json>
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+PORT, PID, OUT = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import faulthandler  # noqa: E402
+
+# a deadlock shows its stacks instead of a silent parent-side timeout
+faulthandler.dump_traceback_later(420, exit=True)
+
+from r2d2_tpu.parallel.distributed import init_distributed  # noqa: E402
+
+init_distributed(coordinator_address=f"localhost:{PORT}", num_processes=2,
+                 process_id=PID)
+
+import numpy as np  # noqa: E402
+
+from r2d2_tpu.config import test_config  # noqa: E402
+from r2d2_tpu.envs.fake import FakeAtariEnv  # noqa: E402
+from r2d2_tpu.train import train  # noqa: E402
+
+cfg = test_config(game_name="Fake", device_replay=True, superstep_k=2,
+                  training_steps=6, log_interval=0.3, num_actors=2,
+                  weight_publish_interval=2,  # force publishes mid-run
+                  mesh_shape=(("dp", 4), ("mp", 2)))
+m = train(cfg, env_factory=lambda c, s: FakeAtariEnv(
+              obs_shape=c.stored_obs_shape, action_dim=4, seed=s + 31 * PID),
+          use_mesh=True, verbose=False)
+
+results = dict(
+    num_updates=int(m["num_updates"]),
+    mean_loss=float(m["mean_loss"]),
+    env_steps=int(m["env_steps"]),
+    fabric_failed=bool(m["fabric_failed"]),
+    loss_finite=bool(np.isfinite(m["mean_loss"])),
+)
+with open(OUT, "w") as f:
+    json.dump(results, f)
+print("train worker", PID, "done")

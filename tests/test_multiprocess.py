@@ -14,9 +14,12 @@ import socket
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 _WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+_TRAIN_WORKER = os.path.join(os.path.dirname(__file__),
+                             "_mp_train_worker.py")
 
 
 def _free_port() -> int:
@@ -25,11 +28,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_runtime(tmp_path):
+def _spawn_workers(worker: str, tmp_path, timeout: float):
+    """Run the 2-process worker script; returns their parsed JSON."""
     port = _free_port()
     env = dict(os.environ)
-    # 4 virtual CPU devices per process (the conftest's 8 applies to THIS
-    # process; workers get their own flag)
     kept = [f for f in env.get("XLA_FLAGS", "").split()
             if not f.startswith("--xla_force_host_platform_device_count")]
     env["XLA_FLAGS"] = " ".join(
@@ -38,7 +40,7 @@ def test_two_process_runtime(tmp_path):
 
     outs = [str(tmp_path / f"proc{i}.json") for i in range(2)]
     procs = [
-        subprocess.Popen([sys.executable, _WORKER, str(port), str(i),
+        subprocess.Popen([sys.executable, worker, str(port), str(i),
                           outs[i]],
                          env=env, stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT, text=True)
@@ -47,7 +49,7 @@ def test_two_process_runtime(tmp_path):
     logs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=540)
+            out, _ = p.communicate(timeout=timeout)
             logs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -57,8 +59,11 @@ def test_two_process_runtime(tmp_path):
     for i, p in enumerate(procs):
         assert p.returncode == 0, (
             f"worker {i} failed (rc={p.returncode}):\n{logs[i]}")
+    return [json.load(open(o)) for o in outs]
 
-    res = [json.load(open(o)) for o in outs]
+
+def test_two_process_runtime(tmp_path):
+    res = _spawn_workers(_WORKER, tmp_path, timeout=540)
 
     for i, r in enumerate(res):
         assert r["process_id"] == i
@@ -88,3 +93,38 @@ def test_two_process_runtime(tmp_path):
 
     # the same loss on both hosts (collective training is in lockstep)
     assert res[0]["loss"] == pytest.approx(res[1]["loss"], rel=1e-6)
+
+    # --- multi-host device replay: each host owns 2 dp groups' slabs ----
+    for i, r in enumerate(res):
+        assert r["local_mesh_shape"] == {"dp": 2, "mp": 2}
+        assert r["ring_groups"] == 2
+        assert r["device_buffer_ready"]
+        assert r["device_replay_updates"] == 4  # 2 super-steps × k=2
+        assert np.isfinite(r["device_replay_loss"])
+        assert r["device_replay_sink_ok"]
+        # every bundle's feedback reached this host's own buffer
+        assert r["device_replay_feedback_steps"] == 4
+        assert r["device_replay_params_synced"], (
+            f"host {i}: params diverged under multi-host device replay")
+    # the loss is a global reduction over BOTH hosts' (different) slab
+    # data — lockstep SPMD must hand every host the same value
+    assert res[0]["device_replay_loss"] == pytest.approx(
+        res[1]["device_replay_loss"], rel=1e-6)
+
+
+def test_two_process_full_train(tmp_path):
+    """The FULL threaded trainer (actors + replay + learner + publishes)
+    across two processes with multi-host device replay.  Regression for
+    the published-params deadlock: an actor thread jitting global-mesh
+    params issues unsynchronised SPMD launches that wedge the pod's
+    collective stream — Learner._publish must hand actors process-local
+    arrays."""
+    res = _spawn_workers(_TRAIN_WORKER, tmp_path, timeout=540)
+    for i, r in enumerate(res):
+        assert not r["fabric_failed"], f"host {i} fabric failed"
+        assert r["num_updates"] >= 6
+        assert r["loss_finite"]
+    assert res[0]["mean_loss"] == pytest.approx(res[1]["mean_loss"],
+                                                rel=1e-6)
+    # env_steps were sync-summed across hosts at exit — both agree
+    assert res[0]["env_steps"] == res[1]["env_steps"] > 0
