@@ -143,24 +143,28 @@ def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64):
     return num_lanes * iterations / dt
 
 
-def _system_bench(wall_seconds: float):
+def _system_bench(wall_seconds: float, *, device_replay: bool = True,
+                  superstep_k: int = 16, num_actors: int = 64,
+                  env_workers: int = 0):
     """Steady-state env-frames/s of the full threaded fabric on fake envs.
 
-    Returns (frames/s, top_spans) where top_spans names the busiest tracer
-    stages (the measured bottleneck)."""
+    Returns (frames/s, top_spans, num_updates) where top_spans names the
+    busiest tracer stages (the measured bottleneck).  The keyword knobs
+    let tools/tune_system.py sweep the same measurement over a grid."""
     from r2d2_tpu.config import Config
     from r2d2_tpu.train import train
 
     cfg = Config().replace(
         game_name="Fake",
-        num_actors=64,
+        num_actors=num_actors,
+        env_workers=env_workers,
         buffer_capacity=200_000,   # 500-block ring ≈ 1.6 GB (in HBM)
         learning_starts=10_000,
         training_steps=1_000_000_000,  # wall-clock bound, not step bound
         log_interval=5.0,
         save_interval=1_000_000_000,
-        device_replay=True,        # HBM-resident ring + in-graph gather
-        superstep_k=16,            # 16 optimizer steps per dispatch
+        device_replay=device_replay,  # HBM-resident ring + in-graph gather
+        superstep_k=superstep_k,      # optimizer steps per dispatch
     )
     metrics = train(cfg, max_wall_seconds=wall_seconds, verbose=False)
 

@@ -1,0 +1,62 @@
+"""Sweep fabric knobs for the full-system benchmark on the real chip.
+
+Runs short ``train()`` sessions on fake envs across a small grid of the
+knobs that govern the system's steady state — ``superstep_k`` (learner
+dispatch granularity), ``num_actors``/``env_workers`` (experience supply),
+``device_replay`` on/off — and prints a table of steady-state
+env-frames/s with the busiest tracer span per cell, so the flagship
+bench.py settings are chosen from measurements instead of guesses.
+
+Each cell IS bench.py's ``_system_bench`` measurement (same config base,
+same steady-state estimator) with the knobs overridden, so the sweep's
+numbers are directly comparable to what bench.py reports.
+
+Run on the TPU host:  python tools/tune_system.py [seconds_per_cell]
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from r2d2_tpu.bench import _system_bench  # noqa: E402
+
+GRID = [
+    # (device_replay, superstep_k, num_actors, env_workers)
+    (True, 8, 64, 0),
+    (True, 16, 64, 0),
+    (True, 32, 64, 0),
+    (True, 16, 64, 8),
+    (True, 16, 128, 8),
+    (False, 1, 64, 0),   # host-staged baseline
+]
+
+
+def main(seconds: float = 60.0) -> None:
+    print(f"{'replay':>7} {'k':>3} {'actors':>6} {'workers':>7} "
+          f"{'frames/s':>12} {'updates':>8}  busiest_span")
+    results = []
+    for device_replay, k, actors, workers in GRID:
+        try:
+            fps, top_spans, updates = _system_bench(
+                seconds, device_replay=device_replay, superstep_k=k,
+                num_actors=actors, env_workers=workers)
+        except Exception as e:  # keep sweeping; report the failure
+            print(f"{'dev' if device_replay else 'host':>7} {k:>3} "
+                  f"{actors:>6} {workers:>7} {'FAILED':>12} "
+                  f"{type(e).__name__}: {e}")
+            continue
+        top = next(iter(top_spans), "-")
+        results.append(dict(device_replay=device_replay, superstep_k=k,
+                            num_actors=actors, env_workers=workers,
+                            frames_per_sec=round(fps, 1), updates=updates,
+                            busiest=top))
+        print(f"{'dev' if device_replay else 'host':>7} {k:>3} {actors:>6} "
+              f"{workers:>7} {fps:>12,.0f} {updates:>8}  {top}")
+    with open("tune_system_results.json", "w") as f:
+        json.dump(results, f, indent=1)
+    print("→ tune_system_results.json")
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 60.0)
