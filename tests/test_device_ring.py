@@ -407,6 +407,35 @@ def test_dp_is_weights_use_per_group_densities():
     assert np.intersect1d(q0, q1).size > 0
 
 
+def test_grouped_sampling_is_unbiased_at_full_correction():
+    """At β=1 the IS-weighted visitation E[count_i · w_i] must be uniform
+    across ALL leaves — including across groups with very different
+    masses.  This is the end-to-end statistical pin of the per-group
+    density math: a sampler that normalised by the wrong mass (e.g. the
+    total tree mass) would systematically over/under-weight one group."""
+    from r2d2_tpu.parallel.mesh import make_mesh
+
+    cfg = make_cfg(mesh_shape=(("dp", 2),),
+                   importance_sampling_exponent=1.0)
+    mesh = make_mesh(cfg)
+    buf, ring = dp_buffers(cfg, mesh, n_blocks=cfg.num_blocks)
+    NB, K = cfg.num_blocks, cfg.seqs_per_block
+    rng = np.random.default_rng(11)
+    # wildly skewed priorities: group 1's slab ~20x group 0's mass
+    prios = rng.random(NB * K) + 0.5
+    prios[NB * K // 2:] *= 20.0
+    buf.tree.update(np.arange(NB * K), prios)
+
+    B, draws = cfg.batch_size, 6000
+    totals = np.zeros(NB * K)
+    for _ in range(draws):
+        idx, q = buf._grouped_densities(B)
+        np.add.at(totals, idx, 1.0 / q)  # β=1 correction, constant dropped
+    # E[count_i · (1/q_i)] = rows_per_group — identical for every leaf
+    expected = draws * (B // 2)
+    np.testing.assert_allclose(totals, expected, rtol=0.15)
+
+
 def test_resolve_layout():
     from r2d2_tpu.parallel.mesh import make_mesh
     from r2d2_tpu.replay.device_ring import resolve_layout
