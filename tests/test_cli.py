@@ -84,50 +84,3 @@ def test_cli_eval_env_uses_noop_start(tmp_path, monkeypatch):
           "--episodes", "1"])
     assert seen and all(seen), "eval env built without noop_start=True"
 
-
-def test_default_config_pins_reference_hyperparameters():
-    """Every reference hyperparameter (config.py:1-37 plus the cadences
-    hardcoded in worker.py/train.py) must survive in the default Config —
-    the parity contract the presets build on."""
-    from r2d2_tpu.config import Config
-
-    cfg = Config()
-    # optimisation (config.py:4-7, 11, 15; worker.py:289,364)
-    assert cfg.lr == 1e-4
-    assert cfg.adam_eps == 1e-3
-    assert cfg.grad_norm == 40.0
-    assert cfg.batch_size == 64
-    assert cfg.gamma == 0.997
-    assert cfg.training_steps == 100_000
-    # prioritised replay (config.py:8, 12-13, 16, 19)
-    assert cfg.prio_exponent == 0.9
-    assert cfg.importance_sampling_exponent == 0.6
-    assert cfg.learning_starts == 50_000
-    assert cfg.buffer_capacity == 2_000_000
-    assert cfg.block_length == 400
-    # sequence windows (config.py:27-30)
-    assert (cfg.burn_in_steps, cfg.learning_steps, cfg.forward_steps) == \
-        (40, 40, 5)
-    assert cfg.seq_len == 85
-    # actor fleet (config.py:18, 21-23)
-    assert cfg.num_actors == 8
-    assert cfg.base_eps == 0.4
-    assert cfg.eps_alpha == 7.0
-    assert cfg.actor_update_interval == 400
-    # cadences (config.py:9-10; worker.py:372)
-    assert cfg.save_interval == 500
-    assert cfg.target_net_update_interval == 2000
-    assert cfg.weight_publish_interval == 4
-    # network / env / eval (config.py:2, 17, 33, 37; environment.py:68;
-    # test.py:17)
-    assert cfg.hidden_dim == 512
-    assert cfg.max_episode_steps == 27_000
-    assert cfg.noop_max == 30
-    assert cfg.frameskip == 4
-    assert cfg.obs_shape == (84, 84, 1)  # NHWC of the reference's (1,84,84)
-    assert cfg.test_epsilon == 0.001
-    assert cfg.eval_episodes == 5
-    # derived ring geometry (worker.py:45-48)
-    assert cfg.num_blocks == 5000
-    assert cfg.num_sequences == 50_000
-    assert cfg.seqs_per_block == 10
