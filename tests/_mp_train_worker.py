@@ -8,7 +8,11 @@ concurrently with the learner's collectives, which deadlocks the pod if
 any published leaf is a global-mesh array (regression: Learner._publish
 must hand actors process-local arrays).
 
-Usage: python _mp_train_worker.py <coordinator_port> <process_id> <out_json>
+Usage: python _mp_train_worker.py <port> <process_id> <out_json> [device_replay]
+
+``device_replay`` (default "1"): "0" runs the host-staged multi-host data
+plane (Learner.run with host_local_batch) instead — the same actor/publish
+concurrency, different learner loop.
 """
 import json
 import os
@@ -19,6 +23,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 PORT, PID, OUT = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+DEVICE_REPLAY = (sys.argv[4] if len(sys.argv) > 4 else "1") == "1"
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import faulthandler  # noqa: E402
@@ -37,7 +42,8 @@ from r2d2_tpu.config import test_config  # noqa: E402
 from r2d2_tpu.envs.fake import FakeAtariEnv  # noqa: E402
 from r2d2_tpu.train import train  # noqa: E402
 
-cfg = test_config(game_name="Fake", device_replay=True, superstep_k=2,
+cfg = test_config(game_name="Fake", device_replay=DEVICE_REPLAY,
+                  superstep_k=2,
                   training_steps=6, log_interval=0.3, num_actors=2,
                   weight_publish_interval=2,  # force publishes mid-run
                   mesh_shape=(("dp", 4), ("mp", 2)))

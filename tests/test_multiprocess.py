@@ -28,7 +28,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_workers(worker: str, tmp_path, timeout: float):
+def _spawn_workers(worker: str, tmp_path, timeout: float, *extra_args):
     """Run the 2-process worker script; returns their parsed JSON."""
     port = _free_port()
     env = dict(os.environ)
@@ -41,7 +41,7 @@ def _spawn_workers(worker: str, tmp_path, timeout: float):
     outs = [str(tmp_path / f"proc{i}.json") for i in range(2)]
     procs = [
         subprocess.Popen([sys.executable, worker, str(port), str(i),
-                          outs[i]],
+                          outs[i], *map(str, extra_args)],
                          env=env, stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT, text=True)
         for i in range(2)
@@ -112,14 +112,17 @@ def test_two_process_runtime(tmp_path):
         res[1]["device_replay_loss"], rel=1e-6)
 
 
-def test_two_process_full_train(tmp_path):
+@pytest.mark.parametrize("device_replay", [1, 0],
+                         ids=["device-replay", "host-staged"])
+def test_two_process_full_train(tmp_path, device_replay):
     """The FULL threaded trainer (actors + replay + learner + publishes)
-    across two processes with multi-host device replay.  Regression for
+    across two processes, on both multi-host data planes.  Regression for
     the published-params deadlock: an actor thread jitting global-mesh
     params issues unsynchronised SPMD launches that wedge the pod's
     collective stream — Learner._publish must hand actors process-local
-    arrays."""
-    res = _spawn_workers(_TRAIN_WORKER, tmp_path, timeout=540)
+    arrays (the hazard is identical for the device-replay and
+    host-staged learner loops)."""
+    res = _spawn_workers(_TRAIN_WORKER, tmp_path, 540, device_replay)
     for i, r in enumerate(res):
         assert not r["fabric_failed"], f"host {i} fabric failed"
         assert r["num_updates"] >= 6
