@@ -108,20 +108,28 @@ def make_act_fn(cfg: Config, net: R2D2Network):
     of the network: the two implementations declare identical parameters
     (models/network.py:resolve_lstm_impl), so the published param
     snapshots apply unchanged — the recurrence engine is just re-chosen
-    for the platform the jit will actually lower on."""
+    for the platform the jit will actually lower on.  A CPU act twin also
+    computes in float32 regardless of ``cfg.compute_dtype`` (bf16 is
+    emulated on CPU; params are float32 either way)."""
     from r2d2_tpu.models.network import create_network, resolve_lstm_impl
 
-    act_net = net
-    if resolve_lstm_impl(cfg) == "pallas" and not cfg.pallas_interpret:
-        act_dev = _resolve_act_device(cfg.act_device)
-        # act_dev None = inference stays wherever the default backend puts
-        # it (e.g. evaluating a TPU-trained, explicitly-pallas config on a
-        # CPU-only host) — judge by that platform instead
-        platform = (act_dev.platform if act_dev is not None
-                    else jax.default_backend())
-        if platform != "tpu":
-            act_net = create_network(cfg.replace(lstm_impl="scan"),
-                                     net.action_dim)
+    act_dev = _resolve_act_device(cfg.act_device)
+    # act_dev None = inference stays wherever the default backend puts it
+    # (e.g. evaluating a TPU-trained, explicitly-pallas config on a
+    # CPU-only host) — judge by that platform instead
+    platform = (act_dev.platform if act_dev is not None
+                else jax.default_backend())
+    twin = {}
+    if (resolve_lstm_impl(cfg) == "pallas" and not cfg.pallas_interpret
+            and platform != "tpu"):
+        twin["lstm_impl"] = "scan"
+    if platform == "cpu" and cfg.compute_dtype == "bfloat16":
+        # bf16 matmuls are emulated (slow) on CPU and params are f32
+        # anyway; the f32 twin is ~25% faster per inference call — material
+        # when the whole fleet shares one host core with the learner loop
+        twin["compute_dtype"] = "float32"
+    act_net = (create_network(cfg.replace(**twin), net.action_dim)
+               if twin else net)
 
     @jax.jit
     def act(params, obs, last_action, last_reward, hidden):

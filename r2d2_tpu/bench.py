@@ -145,7 +145,7 @@ def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64):
 
 def _system_bench(wall_seconds: float, *, device_replay: bool = True,
                   superstep_k: int = 16, num_actors: int = 64,
-                  env_workers: int = 0):
+                  env_workers: int = 0, superstep_pipeline: int = 2):
     """Steady-state env-frames/s of the full threaded fabric on fake envs.
 
     Returns (frames/s, top_spans, num_updates) where top_spans names the
@@ -165,6 +165,11 @@ def _system_bench(wall_seconds: float, *, device_replay: bool = True,
         save_interval=1_000_000_000,
         device_replay=device_replay,  # HBM-resident ring + in-graph gather
         superstep_k=superstep_k,      # optimizer steps per dispatch
+        superstep_pipeline=superstep_pipeline,  # in-flight dispatches: each
+                                      # result fetch is a full tunnel round
+                                      # trip, so harvesting behind >=2
+                                      # in-flight super-steps keeps the
+                                      # device busy while results trail
     )
     metrics = train(cfg, max_wall_seconds=wall_seconds, verbose=False)
 

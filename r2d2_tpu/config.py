@@ -91,6 +91,12 @@ class Config:
                                       # if it fits, else shard)
     superstep_k: int = 8              # train steps fused per dispatch when
                                       # device_replay (learner/step.py)
+    superstep_pipeline: int = 1       # in-flight super-step dispatches the
+                                      # learner keeps ahead of its result
+                                      # harvest (device_replay): higher
+                                      # hides D2H round-trip latency at the
+                                      # cost of priority-feedback lag
+                                      # <= (pipeline+1)*superstep_k updates
     act_device: str = "auto"          # actor inference backend: "auto"
                                       # (CPU when the learner owns an
                                       # accelerator), "cpu", or "default"
@@ -148,6 +154,8 @@ class Config:
             raise ValueError("env_workers must be >= 0")
         if self.superstep_k < 1:
             raise ValueError("superstep_k must be >= 1")
+        if self.superstep_pipeline < 0:
+            raise ValueError("superstep_pipeline must be >= 0")
         if self.device_ring_layout not in ("auto", "replicated", "dp"):
             raise ValueError(
                 f"unknown device_ring_layout {self.device_ring_layout!r}")
@@ -191,7 +199,7 @@ def smoke_config(**kw) -> Config:
 def pong_config(**kw) -> Config:
     """configs[1]: Pong, 64 actors."""
     base = dict(game_name="Pong", num_actors=64, env_workers=8,
-                device_replay=True, superstep_k=16)
+                device_replay=True, superstep_k=16, superstep_pipeline=2)
     base.update(kw)
     return Config(**base)
 
@@ -199,7 +207,7 @@ def pong_config(**kw) -> Config:
 def hard_exploration_config(game: str = "MontezumaRevenge", **kw) -> Config:
     """configs[2]: hard-exploration Atari, 256 actors."""
     base = dict(game_name=game, num_actors=256, env_workers=16,
-                device_replay=True, superstep_k=16)
+                device_replay=True, superstep_k=16, superstep_pipeline=2)
     base.update(kw)
     return Config(**base)
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -347,13 +348,29 @@ class Learner:
 
         losses_hist = []
 
+        def prepare(item):
+            """Called at enqueue time: dispatch the (tiny) result flatten
+            and start its device→host copy NOW, so by harvest time —
+            ``superstep_pipeline`` dispatches later — the bytes are already
+            host-resident and the blocking fetch is cheap.  Without this
+            the transfer would only start inside harvest, putting one full
+            interconnect round trip on the loop per dispatch regardless of
+            pipeline depth."""
+            meta, losses, priorities = item
+            flat = jnp.concatenate([losses, priorities.reshape(-1)])
+            try:
+                flat.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass  # backend without the API: harvest pays the round trip
+            return (meta, flat)
+
         def harvest(item) -> None:
             """Fetch a finished super-step's results and feed them back."""
-            meta, losses, priorities = item
+            meta, flat = item
             with tracer.span("learner.result_sync"):
-                # one D2H round trip for everything the host needs
-                flat = np.asarray(jax.device_get(
-                    jnp.concatenate([losses, priorities.reshape(-1)])))
+                # one D2H fetch for everything the host needs (usually
+                # already prefetched by prepare())
+                flat = np.asarray(jax.device_get(flat))
             self._feed_back(meta, flat[:k], flat[k:].reshape(k, B),
                             priority_sink, losses_hist)
 
@@ -371,7 +388,8 @@ class Learner:
             with tracer.span("learner.sample_meta"):
                 return buffer.sample_meta(k, dispatch=dispatch)
 
-        self._superstep_loop(k, target, t0, gate, sample, harvest)
+        self._superstep_loop(k, target, t0, gate, sample, harvest,
+                             prepare=prepare)
 
         if self.checkpointer is not None:
             self._save(self.num_updates, t0)
@@ -387,14 +405,24 @@ class Learner:
     def _superstep_loop(self, k: int, target: int, t0: float,
                         gate: Callable[[], str],
                         sample: Callable[[], Dict[str, Any]],
-                        harvest: Callable[[Any], None]) -> None:
-        """The depth-1 pipelined super-step driver shared by the
-        single-process and multi-host device-replay paths: dispatch
-        super-step t+1 before syncing t's results, so the D2H round trip
-        rides under the device compute (priority feedback lags ≤ 2k
-        updates — comparable to the reference's 8-batch queue + 4-batch
-        staging lag, worker.py:300-316).  Cadences fire on interval
-        crossings (updates advance by k per dispatch).
+                        harvest: Callable[[Any], None],
+                        prepare: Optional[Callable[[Any], Any]] = None
+                        ) -> None:
+        """The pipelined super-step driver shared by the single-process
+        and multi-host device-replay paths: keep up to
+        ``cfg.superstep_pipeline`` dispatches in flight beyond the one
+        being harvested.  ``prepare`` runs at enqueue time and starts the
+        result D2H transfer immediately (copy_to_host_async), so a
+        harvest ``superstep_pipeline`` dispatches later finds the bytes
+        host-resident — the dispatch cadence is then bounded by device
+        compute, not by the interconnect round trip (~100 ms on a
+        tunneled chip, worse when the host core is contended).  On a
+        backend without async host copies the harvest degrades to one
+        blocking round trip per dispatch.  Priority feedback lags
+        ≤ (pipeline+1)·k updates — at the defaults, comparable to the
+        reference's 8-batch queue + 4-batch staging lag
+        (worker.py:300-316).  Cadences fire on interval crossings
+        (updates advance by k per dispatch).
 
         ``gate()`` → "break" | "wait" | "go" decides each iteration;
         ``sample()`` must return a meta dict whose ``dispatched`` holds
@@ -402,7 +430,7 @@ class Learner:
         """
         cfg = self.cfg
         updates = self.num_updates
-        pending = None
+        pending: deque = deque()
         while updates < target:
             g = gate()
             if g == "break":
@@ -412,9 +440,10 @@ class Learner:
                 continue
             meta = sample()
             self.state, losses, priorities = meta["dispatched"]
-            if pending is not None:
-                harvest(pending)
-            pending = (meta, losses, priorities)
+            item = (meta, losses, priorities)
+            pending.append(prepare(item) if prepare is not None else item)
+            while len(pending) > cfg.superstep_pipeline:
+                harvest(pending.popleft())
 
             prev, updates = updates, updates + k
             if (self.param_store is not None
@@ -425,8 +454,8 @@ class Learner:
                     and updates // cfg.save_interval
                     > prev // cfg.save_interval):
                 self._save(updates, t0)
-        if pending is not None:
-            harvest(pending)
+        while pending:
+            harvest(pending.popleft())
 
     def _feed_back(self, meta, losses_np: np.ndarray, prios_np: np.ndarray,
                    priority_sink: Optional[PrioritySink],
@@ -533,6 +562,18 @@ class Learner:
 
         losses_hist = []
 
+        def prepare(item):
+            """Start the result D2H copies at enqueue time (addressable
+            shards only) so the later harvest finds them host-resident —
+            see :meth:`_superstep_loop`."""
+            _, losses, priorities = item
+            for arr in (losses, priorities):
+                try:
+                    arr.copy_to_host_async()
+                except (AttributeError, NotImplementedError):
+                    pass  # backend without the API: harvest pays the trip
+            return item
+
         def harvest(item) -> None:
             meta, losses, priorities = item
             with tracer.span("learner.result_sync"):
@@ -579,7 +620,8 @@ class Learner:
                                           dispatch=dispatch,
                                           raw_densities=True)
 
-        self._superstep_loop(k, target, t0, gate, sample, harvest)
+        self._superstep_loop(k, target, t0, gate, sample, harvest,
+                             prepare=prepare)
 
         if self.checkpointer is not None:
             self._save(self.num_updates, t0)

@@ -248,15 +248,17 @@ class DeviceRing:
         return sum(int(np.prod(a.shape)) * a.dtype.itemsize
                    for a in self.arrays.values())
 
-    def write(self, block: Block, ptr: int) -> None:
-        """Stream one block into (physical) ring slot ``ptr`` (H2D once per
-        block; caller holds the coordinating lock — see the module
-        contract).
+    def stage(self, block: Block) -> Dict[str, jnp.ndarray]:
+        """Host-side half of a ring write: zero-pad the block to the fixed
+        slot shape and start its H2D transfers.  Needs NO lock — staging
+        touches no ring state, so callers should do it *outside* the
+        coordinating lock (the transfers are the expensive part of a
+        write; holding the lock across them would stall a concurrent
+        sample+dispatch for the full H2D latency).
 
-        Short blocks are zero-padded to the fixed slot shape; the padding
-        occupies exactly the positions the host ring would leave stale,
-        which the sampling clamp invariant already guarantees are
-        loss-masked.
+        Short blocks are zero-padded; the padding occupies exactly the
+        positions the host ring would leave stale, which the sampling
+        clamp invariant already guarantees are loss-masked.
         """
         slot = {}
         for k, (shape, dtype) in self._slot_shapes.items():
@@ -267,8 +269,20 @@ class DeviceRing:
             else:
                 arr[:src.shape[0]] = src
             slot[k] = self._put_slot(arr)
+        return slot
+
+    def commit(self, slot: Dict[str, jnp.ndarray], ptr: int) -> None:
+        """Device-side half of a ring write: the donated in-place update
+        into (physical) slot ``ptr``.  Caller holds the coordinating lock
+        (see the module contract) — this is just one async dispatch, so
+        the lock hold is microseconds."""
         self.arrays = self._write_fn(self.arrays, slot,
                                      jnp.asarray(ptr, jnp.int32))
+
+    def write(self, block: Block, ptr: int) -> None:
+        """stage + commit in one call (caller holds the coordinating
+        lock — see the module contract)."""
+        self.commit(self.stage(block), ptr)
 
     def snapshot(self) -> Dict[str, jnp.ndarray]:
         """Current ring handles, safe to pass to a train-step dispatch

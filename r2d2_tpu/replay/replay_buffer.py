@@ -194,6 +194,13 @@ class ReplayBuffer:
         """Overwrite the ring slot at ``block_ptr`` (worker.py:141-161)."""
         cfg = self.cfg
         K = cfg.seqs_per_block
+        # Stage the device copy OUTSIDE the lock: the zero-pad + H2D
+        # transfers are the expensive part of a device-ring write, and the
+        # learner's sample+dispatch serialises on this same lock.  Only the
+        # donated commit (one async dispatch) needs the ordering the lock
+        # provides.
+        staged = (self.device_ring.stage(block)
+                  if self.device_ring is not None else None)
         with self.lock:
             ptr = self.block_ptr
             # every array (and the PER leaves) is keyed by the PHYSICAL
@@ -205,11 +212,11 @@ class ReplayBuffer:
             self.size -= int(self.block_learning_total[slot])
 
             k = block.num_sequences
-            if self.device_ring is not None:
+            if staged is not None:
                 # bulk data goes straight to HBM (once per block); the
                 # stream-order/donation contract is upheld because we hold
                 # self.lock, the same lock sample_meta dispatches under
-                self.device_ring.write(block, slot)
+                self.device_ring.commit(staged, slot)
             else:
                 n_obs = block.obs.shape[0]
                 n_steps = block.action.shape[0]

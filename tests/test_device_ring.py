@@ -539,6 +539,32 @@ def test_run_device_cadences_and_drain(tmp_path):
     assert 12 in ck.steps() and len(ck.steps()) >= 2
 
 
+@pytest.mark.parametrize("depth", [0, 3])
+def test_run_device_pipeline_depths(depth):
+    """The super-step pipeline must deliver every dispatched sub-batch's
+    priorities exactly once at any depth — 0 (fully synchronous harvest)
+    and deeper-than-default (more in-flight dispatches than the drain at
+    exit, exercising the final drain loop)."""
+    from r2d2_tpu.learner.learner import Learner
+
+    cfg = make_cfg(training_steps=12, superstep_k=2,
+                   superstep_pipeline=depth)
+    _, dev, ring = paired_buffers(cfg, n_blocks=4)
+    net = create_network(cfg, A)
+    learner = Learner(cfg, net, create_train_state(
+        cfg, init_params(cfg, net, jax.random.PRNGKey(7))))
+
+    sunk = []
+    metrics = learner.run_device(
+        dev, ring,
+        priority_sink=lambda i, p, ptr, l: sunk.append((i.copy(), p.copy())))
+
+    assert metrics["num_updates"] == 12
+    assert len(sunk) == 12  # one sink call per update, none stranded
+    assert all(np.all(np.isfinite(p)) for _, p in sunk)
+    assert np.isfinite(metrics["mean_loss"])
+
+
 def test_run_device_stop_midway():
     """A stop() between super-steps exits promptly and still harvests the
     in-flight super-step."""

@@ -22,37 +22,39 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from r2d2_tpu.bench import _system_bench  # noqa: E402
 
 GRID = [
-    # (device_replay, superstep_k, num_actors, env_workers)
-    (True, 8, 64, 0),
-    (True, 16, 64, 0),
-    (True, 32, 64, 0),
-    (True, 16, 64, 8),
-    (True, 16, 128, 8),
-    (False, 1, 64, 0),   # host-staged baseline
+    # (device_replay, superstep_k, num_actors, env_workers, pipeline)
+    (True, 16, 64, 0, 1),
+    (True, 16, 64, 0, 2),
+    (True, 16, 64, 0, 4),
+    (True, 32, 64, 0, 2),
+    (True, 64, 64, 0, 2),
+    (False, 1, 64, 0, 1),   # host-staged baseline
 ]
 
 
 def main(seconds: float = 60.0) -> None:
-    print(f"{'replay':>7} {'k':>3} {'actors':>6} {'workers':>7} "
+    print(f"{'replay':>7} {'k':>3} {'actors':>6} {'workers':>7} {'pipe':>4} "
           f"{'frames/s':>12} {'updates':>8}  busiest_span")
     results = []
-    for device_replay, k, actors, workers in GRID:
+    for device_replay, k, actors, workers, pipe in GRID:
         try:
             fps, top_spans, updates = _system_bench(
                 seconds, device_replay=device_replay, superstep_k=k,
-                num_actors=actors, env_workers=workers)
+                num_actors=actors, env_workers=workers,
+                superstep_pipeline=pipe)
         except Exception as e:  # keep sweeping; report the failure
             print(f"{'dev' if device_replay else 'host':>7} {k:>3} "
-                  f"{actors:>6} {workers:>7} {'FAILED':>12} "
+                  f"{actors:>6} {workers:>7} {pipe:>4} {'FAILED':>12} "
                   f"{type(e).__name__}: {e}")
             continue
         top = next(iter(top_spans), "-")
         results.append(dict(device_replay=device_replay, superstep_k=k,
                             num_actors=actors, env_workers=workers,
+                            superstep_pipeline=pipe,
                             frames_per_sec=round(fps, 1), updates=updates,
                             busiest=top))
         print(f"{'dev' if device_replay else 'host':>7} {k:>3} {actors:>6} "
-              f"{workers:>7} {fps:>12,.0f} {updates:>8}  {top}")
+              f"{workers:>7} {pipe:>4} {fps:>12,.0f} {updates:>8}  {top}")
     with open("tune_system_results.json", "w") as f:
         json.dump(results, f, indent=1)
     print("→ tune_system_results.json")
