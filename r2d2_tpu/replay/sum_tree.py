@@ -1,4 +1,4 @@
-"""Vectorised prioritised-replay sum tree (host-side numpy).
+"""Vectorised prioritised-replay sum tree (host-side).
 
 Same capability as the reference's ``PriorityTree`` (priority_tree.py:4-45):
 flat-array binary sum tree, batched leaf updates with level-by-level upward
@@ -6,12 +6,20 @@ propagation, stratified proportional sampling with a vectorised top-down
 descent, and min-normalised importance-sampling weights.  Stays on the host by
 design — it is O(log n) pointer-chasing, the wrong shape for the MXU; the
 TPU sees only the resulting batch indices/weights.
+
+The update/descent hot loops run under the replay-buffer lock on a host
+core shared with actor inference, so they dispatch to the native C fast
+path (r2d2_tpu/native — exact bit-identical ports that also release the
+GIL) when it is available, and fall back to the numpy implementations
+otherwise (``R2D2_NO_NATIVE=1`` forces the fallback).
 """
 from __future__ import annotations
 
 from typing import Optional, Tuple
 
 import numpy as np
+
+from r2d2_tpu import native
 
 
 class SumTree:
@@ -42,6 +50,9 @@ class SumTree:
         if idxes.size == 0:
             return
         prios = np.asarray(td_errors, dtype=np.float64) ** self.prio_exponent
+        if native.st_update(self.nodes, self.num_levels, self.leaf_offset,
+                            idxes, prios):
+            return
         nodes = idxes + self.leaf_offset
         self.nodes[nodes] = prios
         for _ in range(self.num_levels - 1):
@@ -51,6 +62,9 @@ class SumTree:
     def _descend(self, targets: np.ndarray) -> np.ndarray:
         """Vectorised lock-step top-down descent: prefix-sum targets →
         leaf *node* ids (priority_tree.py:26-44 analogue)."""
+        out = native.st_descend(self.nodes, self.num_levels, targets)
+        if out is not None:
+            return out
         targets = targets.copy()
         nodes = np.zeros(targets.shape[0], dtype=np.int64)
         for _ in range(self.num_levels - 1):
@@ -90,7 +104,17 @@ class SumTree:
     def prefix_mass(self, leaf_idx: int) -> float:
         """Total priority mass of all leaves strictly before ``leaf_idx``
         (O(log n) root walk)."""
-        node = int(leaf_idx) + self.leaf_offset
+        leaf_idx = int(leaf_idx)
+        if leaf_idx >= self.leaf_offset + 1:
+            # every leaf is strictly before: the root walk below (and its C
+            # port) would start one node past the array when the leaf layer
+            # is exactly ``capacity`` (power-of-two capacities) and return
+            # 0.0 — e.g. ready()'s last-group mass at num_sequences=4096
+            return self.total
+        mass = native.st_prefix_mass(self.nodes, self.leaf_offset, leaf_idx)
+        if mass is not None:
+            return mass
+        node = leaf_idx + self.leaf_offset
         mass = 0.0
         while node > 0:
             parent = (node - 1) // 2

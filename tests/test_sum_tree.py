@@ -100,3 +100,90 @@ def test_sample_range_stays_in_range_and_is_proportional():
     freq = counts[lo:hi] / counts[lo:hi].sum()
     expect = leaf / leaf.sum()
     np.testing.assert_allclose(freq, expect, atol=0.02)
+
+
+def test_native_fast_path_matches_numpy_exactly():
+    """The C hot loops (r2d2_tpu/native) must be bit-identical to the
+    numpy implementations: same update sums, same descent choices, same
+    prefix masses.  Skipped when no C compiler is available (the numpy
+    fallback is then the only path and is already covered above)."""
+    from r2d2_tpu import native
+
+    if not native.available():
+        pytest.skip("native sumtree library unavailable (no compiler?)")
+
+    rng = np.random.default_rng(11)
+    nat = make_tree(capacity=100, seed=3)
+    ref = make_tree(capacity=100, seed=3)
+    assert nat.nodes is not ref.nodes
+
+    for round_ in range(20):
+        idx = rng.choice(100, size=rng.integers(1, 40), replace=False)
+        td = rng.random(idx.size) + 1e-3
+        # native path on one tree, forced-numpy path on the other
+        nat.update(idx, td)
+        prios = td.astype(np.float64) ** ref.prio_exponent
+        nodes = idx.astype(np.int64) + ref.leaf_offset
+        ref.nodes[nodes] = prios
+        for _ in range(ref.num_levels - 1):
+            nodes = np.unique((nodes - 1) // 2)
+            ref.nodes[nodes] = (ref.nodes[2 * nodes + 1]
+                                + ref.nodes[2 * nodes + 2])
+        np.testing.assert_array_equal(nat.nodes, ref.nodes)
+
+        # identical RNG state -> identical targets -> descents must agree
+        i_n, w_n = nat.sample(16)
+        i_r, w_r = ref.sample(16)
+        np.testing.assert_array_equal(i_n, i_r)
+        np.testing.assert_array_equal(w_n, w_r)
+        for leaf in (0, 1, 37, 99, 100):
+            assert nat.prefix_mass(leaf) == ref.prefix_mass(leaf)
+
+
+def test_native_update_large_batch_path():
+    """Batches beyond the C scratch bound (1024) take the per-path walk —
+    sums must still repair exactly."""
+    from r2d2_tpu import native
+
+    if not native.available():
+        pytest.skip("native sumtree library unavailable (no compiler?)")
+    rng = np.random.default_rng(12)
+    t = SumTree(2048, prio_exponent=1.0, is_exponent=0.6,
+                rng=np.random.default_rng(0))
+    td = rng.random(2048) + 0.01
+    t.update(np.arange(2048), td)
+    np.testing.assert_allclose(t.total, td.sum(), rtol=1e-12)
+    leaf = t.nodes[t.leaf_offset:t.leaf_offset + 2048]
+    np.testing.assert_array_equal(leaf, td)
+
+
+def test_no_native_env_forces_fallback(monkeypatch):
+    """R2D2_NO_NATIVE=1 must disable the C path cleanly (fresh load
+    state), leaving the numpy implementation fully functional."""
+    from r2d2_tpu import native
+
+    monkeypatch.setenv("R2D2_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_lib", None)
+    # monkeypatch teardown restores _tried/_lib/env to pre-test values
+    assert not native.available()
+    t = make_tree(capacity=32, seed=5)
+    t.update(np.arange(10), np.ones(10))
+    idx, w = t.sample(8)
+    assert idx.shape == (8,) and np.all(w > 0)
+
+
+def test_prefix_mass_full_layer_power_of_two_capacity():
+    """Regression: with a power-of-two capacity the leaf layer is exactly
+    ``capacity`` wide and ``prefix_mass(capacity)`` used to walk from one
+    node past the array, returning 0.0 instead of the total — which made
+    a dp-grouped buffer's last-group mass non-positive (ready() stuck
+    False) whenever num_sequences was a power of two."""
+    t = SumTree(128, prio_exponent=1.0, is_exponent=0.6,
+                rng=np.random.default_rng(0))
+    t.update(np.arange(128), np.ones(128))
+    assert t.prefix_mass(128) == pytest.approx(t.total, rel=1e-12)
+    assert t.prefix_mass(200) == pytest.approx(t.total, rel=1e-12)
+    assert t.prefix_mass(127) == pytest.approx(t.total - 1.0, rel=1e-12)
+    # the dp ready() pattern: last group's slab mass must be positive
+    assert t.prefix_mass(128) - t.prefix_mass(64) == pytest.approx(64.0)
