@@ -125,7 +125,7 @@ def make_act_fn(cfg: Config, net: R2D2Network):
         twin["lstm_impl"] = "scan"
     if platform == "cpu" and cfg.compute_dtype == "bfloat16":
         # bf16 matmuls are emulated (slow) on CPU and params are f32
-        # anyway; the f32 twin is ~25% faster per inference call — material
+        # anyway; the f32 twin is ~30% faster per inference call — material
         # when the whole fleet shares one host core with the learner loop
         twin["compute_dtype"] = "float32"
     act_net = (create_network(cfg.replace(**twin), net.action_dim)

@@ -164,12 +164,14 @@ def _system_bench(wall_seconds: float, *, device_replay: bool = True,
         log_interval=5.0,
         save_interval=1_000_000_000,
         device_replay=device_replay,  # HBM-resident ring + in-graph gather
-        superstep_k=superstep_k,      # optimizer steps per dispatch
-        superstep_pipeline=superstep_pipeline,  # in-flight dispatches: each
-                                      # result fetch is a full tunnel round
-                                      # trip, so harvesting behind >=2
-                                      # in-flight super-steps keeps the
-                                      # device busy while results trail
+        superstep_k=superstep_k,      # optimizer steps per dispatch — the
+                                      # pong/hard-exploration presets' value,
+                                      # so the system number measures what
+                                      # the learning configs actually run
+        superstep_pipeline=superstep_pipeline,  # in-flight dispatches:
+                                      # result copies start at enqueue, so
+                                      # >=2 keeps the device busy while
+                                      # results trail
     )
     metrics = train(cfg, max_wall_seconds=wall_seconds, verbose=False)
 

@@ -8,8 +8,8 @@ ports (bit-identical arithmetic, see native/sumtree.c) and release the
 GIL for the duration of the call.
 
 Build model: ``cc -O2 -shared -fPIC`` at first use into a cache directory
-(``$R2D2_NATIVE_CACHE`` or ``~/.cache/r2d2_tpu``), keyed by source mtime;
-loaded via ctypes (no Python.h / pybind dependency).  Anything failing —
+(``$R2D2_NATIVE_CACHE`` or ``~/.cache/r2d2_tpu``), keyed by a content
+hash of the source; loaded via ctypes (no Python.h / pybind dependency).  Anything failing —
 no compiler, read-only cache, load error — degrades silently to the numpy
 implementations (``R2D2_NO_NATIVE=1`` forces that).
 """

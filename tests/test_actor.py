@@ -200,3 +200,33 @@ def test_vector_actor_256_lanes_lifecycle():
         k = blk.num_sequences
         assert blk.forward_steps[k - 1] == 1
         assert blk.action.shape[0] == blk.learning_steps.sum()
+
+
+def test_act_fn_cpu_f32_twin_matches_bf16_net():
+    """With a bf16 compute dtype and CPU inference, make_act_fn builds a
+    float32 twin (bf16 matmuls are emulated on CPU).  The twin shares the
+    (float32) param pytree and must agree with the bf16 network's act
+    output to bf16 tolerance — the actor's policy is unchanged."""
+    from r2d2_tpu.models.network import R2D2Network
+
+    cfg = make_test_config(compute_dtype="bfloat16")
+    net_bf16 = create_network(cfg, A)
+    params = init_params(cfg, net_bf16, jax.random.PRNGKey(9))
+    act = make_act_fn(cfg, net_bf16)  # CPU platform -> f32 twin
+
+    rng = np.random.default_rng(4)
+    B = 5
+    obs = rng.integers(0, 256, (B, *cfg.stored_obs_shape), dtype=np.uint8)
+    la = np.zeros((B, A), np.float32)
+    la[np.arange(B), rng.integers(A, size=B)] = 1.0
+    lr = rng.normal(size=B).astype(np.float32)
+    hid = rng.normal(size=(B, 2, cfg.lstm_layers,
+                           cfg.hidden_dim)).astype(np.float32) * 0.1
+
+    q_twin, h_twin = act(params, obs, la, lr, hid)
+    q_ref, h_ref = net_bf16.apply(params, obs, la, lr, hid,
+                                  method=R2D2Network.act)
+    np.testing.assert_allclose(np.asarray(q_twin), np.asarray(q_ref),
+                               rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(np.asarray(h_twin), np.asarray(h_ref),
+                               rtol=0.05, atol=0.05)

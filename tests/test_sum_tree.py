@@ -117,10 +117,35 @@ def test_native_fast_path_matches_numpy_exactly():
     ref = make_tree(capacity=100, seed=3)
     assert nat.nodes is not ref.nodes
 
+    def ref_descend(targets):
+        # inline numpy reference (NOT SumTree methods — those would also
+        # dispatch to native, making the comparison vacuous)
+        t = targets.copy()
+        nodes = np.zeros(t.shape[0], dtype=np.int64)
+        for _ in range(ref.num_levels - 1):
+            left = 2 * nodes + 1
+            lm = ref.nodes[left]
+            go_right = t >= lm
+            nodes = np.where(go_right, left + 1, left)
+            t = np.where(go_right, t - lm, t)
+        return nodes
+
+    def ref_prefix_mass(leaf_idx):
+        if leaf_idx >= ref.leaf_offset + 1:
+            return float(ref.nodes[0])
+        node = leaf_idx + ref.leaf_offset
+        mass = 0.0
+        while node > 0:
+            parent = (node - 1) // 2
+            if node == 2 * parent + 2:
+                mass += float(ref.nodes[2 * parent + 1])
+            node = parent
+        return mass
+
     for round_ in range(20):
         idx = rng.choice(100, size=rng.integers(1, 40), replace=False)
         td = rng.random(idx.size) + 1e-3
-        # native path on one tree, forced-numpy path on the other
+        # native path on one tree, inline-numpy repair on the other
         nat.update(idx, td)
         prios = td.astype(np.float64) ** ref.prio_exponent
         nodes = idx.astype(np.int64) + ref.leaf_offset
@@ -131,13 +156,22 @@ def test_native_fast_path_matches_numpy_exactly():
                                 + ref.nodes[2 * nodes + 2])
         np.testing.assert_array_equal(nat.nodes, ref.nodes)
 
-        # identical RNG state -> identical targets -> descents must agree
+        # identical RNG state -> identical stratified targets; nat.sample
+        # descends in C, the reference descent is inline numpy above
+        total = ref.nodes[0]
+        interval = total / 16
+        targets = interval * np.arange(16, dtype=np.float64)
+        targets += ref.rng.uniform(0.0, interval, 16)
         i_n, w_n = nat.sample(16)
-        i_r, w_r = ref.sample(16)
-        np.testing.assert_array_equal(i_n, i_r)
-        np.testing.assert_array_equal(w_n, w_r)
+        ref_nodes = ref_descend(targets)
+        np.testing.assert_array_equal(i_n, ref_nodes - ref.leaf_offset)
+        rp = ref.nodes[ref_nodes]
+        pos = rp[rp > 0]
+        min_p = pos.min() if pos.size else 1.0
+        rp = np.maximum(rp, min_p)
+        np.testing.assert_array_equal(w_n, (rp / min_p) ** (-ref.is_exponent))
         for leaf in (0, 1, 37, 99, 100):
-            assert nat.prefix_mass(leaf) == ref.prefix_mass(leaf)
+            assert nat.prefix_mass(leaf) == ref_prefix_mass(leaf)
 
 
 def test_native_update_large_batch_path():
