@@ -91,12 +91,16 @@ class Config:
                                       # if it fits, else shard)
     superstep_k: int = 8              # train steps fused per dispatch when
                                       # device_replay (learner/step.py)
-    superstep_pipeline: int = 1       # in-flight super-step dispatches the
-                                      # learner keeps ahead of its result
-                                      # harvest (device_replay): higher
-                                      # hides D2H round-trip latency at the
-                                      # cost of priority-feedback lag
-                                      # <= (pipeline+1)*superstep_k updates
+    superstep_pipeline: int = 1       # in-flight dispatches the learner
+                                      # keeps ahead of its result harvest
+                                      # (both learner loops): hides D2H
+                                      # round-trip latency at the cost of
+                                      # priority-feedback lag — up to
+                                      # (pipeline+1)*superstep_k updates
+                                      # under device_replay, up to pipeline
+                                      # single steps in the host-staged
+                                      # loop (train_sync forces 0: inline
+                                      # feedback)
     act_device: str = "auto"          # actor inference backend: "auto"
                                       # (CPU when the learner owns an
                                       # accelerator), "cpu", or "default"

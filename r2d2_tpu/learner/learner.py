@@ -140,6 +140,13 @@ class Learner:
         """Drive training until ``cfg.training_steps`` (or ``max_steps`` more
         updates, or ``stop()``).  Returns summary metrics.
 
+        Results (loss + priorities) are harvested behind up to
+        ``cfg.superstep_pipeline`` in-flight steps with their D2H copies
+        started at dispatch time — same latency-hiding scheme as the
+        device-replay driver (:meth:`_superstep_loop`); priority feedback
+        lags ≤ pipeline steps (0 = fully synchronous, the train_sync
+        setting).
+
         ``tracer`` (utils/trace.Tracer) records per-stage spans: batch wait,
         jitted step dispatch, and the device→host result sync."""
         cfg = self.cfg
@@ -221,8 +228,43 @@ class Learner:
             return any_host(bool(stop()) if stop is not None else False)
 
         losses = []
+
+        def harvest(pending_item) -> None:
+            """Fetch one in-flight step's results and feed them back.
+            The copies were started at dispatch time, so behind a nonzero
+            pipeline the fetch usually finds host-resident bytes instead
+            of paying a fresh interconnect round trip."""
+            host, loss, priorities = pending_item
+            with tracer.span("learner.result_sync"):
+                loss = float(jax.device_get(loss))
+                # loss is replicated (addressable everywhere); priorities
+                # are dp-sharded, so under a mesh read back only this
+                # host's rows — they pair with the idxes this host sampled
+                if self.mesh is not None:
+                    from r2d2_tpu.parallel.distributed import local_rows
+
+                    priorities = local_rows(priorities)
+                else:
+                    priorities = np.asarray(jax.device_get(priorities))
+            losses.append(loss)
+            self.env_steps = int(host.get("env_steps", self.env_steps))
+            if priority_sink is not None:
+                priority_sink(host["idxes"], priorities,
+                              host["block_ptr"], loss)
+
+        # track the update count host-side: self.num_updates is a device
+        # fetch of state.step — one interconnect round trip per read, so
+        # reading it every iteration would serialise the loop on latency
+        updates = self.num_updates
+        # NOTE: this pending/harvest/drain shape mirrors _superstep_loop
+        # (the device-replay driver) deliberately rather than sharing it:
+        # this loop is queue-fed with per-item host metadata and a
+        # collective batch-exhaustion break, which don't fit the
+        # gate/sample contract there.  A pipeline-logic fix in one loop
+        # likely applies to the other — check both.
+        pending: deque = deque()
         try:
-            while self.num_updates < target:
+            while updates < target:
                 if should_stop():
                     break
                 with tracer.span("learner.batch_wait"):
@@ -238,32 +280,24 @@ class Learner:
                 with tracer.span("learner.step_dispatch"):
                     self.state, loss, priorities = self._step_fn(self.state,
                                                                  dev_batch)
-                # one device→host sync per step: loss + priorities together.
-                # loss is replicated (addressable everywhere); priorities
-                # are dp-sharded, so under a mesh read back only this
-                # host's rows — they pair with the idxes this host sampled
-                with tracer.span("learner.result_sync"):
-                    loss = float(jax.device_get(loss))
-                    if self.mesh is not None:
-                        from r2d2_tpu.parallel.distributed import local_rows
+                for arr in (loss, priorities):
+                    try:
+                        arr.copy_to_host_async()
+                    except (AttributeError, NotImplementedError):
+                        pass  # backend without the API: harvest pays the trip
+                pending.append((host, loss, priorities))
+                while len(pending) > cfg.superstep_pipeline:
+                    harvest(pending.popleft())
 
-                        priorities = local_rows(priorities)
-                    else:
-                        priorities = np.asarray(jax.device_get(priorities))
-                losses.append(loss)
-                self.env_steps = int(host.get("env_steps", self.env_steps))
-
-                if priority_sink is not None:
-                    priority_sink(host["idxes"], priorities,
-                                  host["block_ptr"], loss)
-
-                updates = self.num_updates
+                updates += 1
                 if (self.param_store is not None
                         and updates % cfg.weight_publish_interval == 0):
                     self._publish()
                 if (self.checkpointer is not None
                         and updates % cfg.save_interval == 0):
                     self._save(updates, t0)
+            while pending:
+                harvest(pending.popleft())
         finally:
             done.set()
 

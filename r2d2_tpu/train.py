@@ -208,10 +208,11 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     # prefetch would run batch_source (which steps the actor) on a thread,
     # and env workers would make block arrival order racy — both break the
     # deterministic interleaving this function promises; device_replay's
-    # k-step dispatch granularity likewise (this path applies priority
-    # feedback after every single update)
+    # k-step dispatch granularity likewise, and a nonzero result pipeline
+    # would defer priority feedback (this path applies it after every
+    # single update)
     cfg = cfg.replace(prefetch_batches=0, env_workers=0,
-                      device_replay=False)
+                      device_replay=False, superstep_pipeline=0)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     actor: VectorActor = sys["actor"]
     buffer: ReplayBuffer = sys["buffer"]

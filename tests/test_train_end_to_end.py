@@ -217,3 +217,26 @@ def test_trained_policy_beats_random():
     score = evaluate_params(cfg, net, trained, env_factory, episodes=5,
                             epsilon=cfg.test_epsilon, seed=11)
     assert score > rand_score, (score, rand_score)
+
+
+@pytest.mark.parametrize("depth", [0, 3])
+def test_host_staged_run_pipeline_depths(depth):
+    """Learner.run's result pipeline must deliver every step's priorities
+    exactly once at any depth (0 = fully synchronous, >1 exercises the
+    exit drain), with the host-side update counter staying exact."""
+    cfg = make_test_config(training_steps=7, superstep_pipeline=depth)
+    net = create_network(cfg, A)
+    learner = Learner(cfg, net, create_train_state(
+        cfg, init_params(cfg, net, jax.random.PRNGKey(3))))
+
+    batches = _scripted_batches(cfg, 7)
+    it = iter(batches)
+    sunk = []
+    metrics = learner.run(
+        lambda: next(it, None),
+        priority_sink=lambda i, p, ptr, l: sunk.append((i.copy(), p.copy())))
+
+    assert metrics["num_updates"] == 7 == learner.num_updates
+    assert len(sunk) == 7
+    assert all(np.all(np.isfinite(p)) for _, p in sunk)
+    assert np.isfinite(metrics["mean_loss"])
