@@ -83,6 +83,14 @@ class Config:
     env_workers: int = 0              # >1: thread-pool env stepping (the
                                       # reference's N-process parallelism,
                                       # train.py:30-34); 0/1 = serial
+    actor_fleets: int = 1             # independent lockstep fleets, each
+                                      # its own thread: fleet A's env
+                                      # stepping overlaps fleet B's batched
+                                      # inference on multi-core hosts (the
+                                      # reference's N actor processes,
+                                      # train.py:30-34, regrouped); lanes
+                                      # split contiguously, ladder epsilons
+                                      # stay global
     device_replay: bool = False       # replay data lives in HBM; batches
                                       # are gathered in-graph (device_ring)
     device_ring_layout: str = "auto"  # "replicated" (full ring per device)
@@ -156,6 +164,10 @@ class Config:
             raise ValueError("num_actors must be >= 1")
         if self.env_workers < 0:
             raise ValueError("env_workers must be >= 0")
+        if not (1 <= self.actor_fleets <= self.num_actors):
+            raise ValueError(
+                f"actor_fleets ({self.actor_fleets}) must be in "
+                f"[1, num_actors={self.num_actors}]")
         if self.superstep_k < 1:
             raise ValueError("superstep_k must be >= 1")
         if self.superstep_pipeline < 0:
@@ -193,6 +205,15 @@ class Config:
 
 # --- presets mirroring BASELINE.json configs[0..4] ------------------------
 
+def _clamp_fleets(base: dict, kw: dict) -> dict:
+    """Presets that default ``actor_fleets`` > 1 must not make a
+    scaled-down ``num_actors`` override (e.g. ``--actors 2``) invalid;
+    clamp the default — but never an explicit ``actor_fleets`` override —
+    to the actor count."""
+    if "actor_fleets" not in kw:
+        base["actor_fleets"] = min(base["actor_fleets"], base["num_actors"])
+    return base
+
 def smoke_config(**kw) -> Config:
     """configs[0]: MsPacman, 1 actor, LSTM-512 CPU smoke."""
     base = dict(game_name="MsPacman", num_actors=1)
@@ -211,19 +232,20 @@ def pong_config(**kw) -> Config:
 def hard_exploration_config(game: str = "MontezumaRevenge", **kw) -> Config:
     """configs[2]: hard-exploration Atari, 256 actors."""
     base = dict(game_name=game, num_actors=256, env_workers=16,
+                actor_fleets=4,
                 device_replay=True, superstep_k=16, superstep_pipeline=2)
     base.update(kw)
-    return Config(**base)
+    return Config(**_clamp_fleets(base, kw))
 
 
 def atari57_config(game: str, **kw) -> Config:
     """configs[3]: Atari-57 sweep, 256 actors, seq-len 80 (paper hyperparams)."""
     base = dict(
-        game_name=game, num_actors=256, env_workers=16,
+        game_name=game, num_actors=256, env_workers=16, actor_fleets=4,
         burn_in_steps=40, learning_steps=40, forward_steps=5,
     )
     base.update(kw)
-    return Config(**base)
+    return Config(**_clamp_fleets(base, kw))
 
 
 def impala_deep_config(game: str = "MsPacman", **kw) -> Config:

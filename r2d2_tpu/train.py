@@ -175,11 +175,28 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
     act_fn = make_act_fn(cfg, net)
     epsilons = [epsilon_ladder(i, cfg.num_actors, cfg.base_eps, cfg.eps_alpha)
                 for i in range(cfg.num_actors)]
-    actor = VectorActor(cfg, envs, epsilons, act_fn, param_store,
-                        sink=buffer.add,
-                        rng=np.random.default_rng(cfg.seed + 7919))
+    # actor_fleets independent lockstep fleets over contiguous lane slices:
+    # the ladder epsilons stay GLOBAL (lane i keeps epsilon_ladder(i, N)
+    # regardless of fleet count — the reference's per-actor ladder,
+    # train.py:15-17), and each fleet gets its own RNG stream and thread
+    # so one fleet's env stepping overlaps another's batched inference
+    F = cfg.actor_fleets
+    bounds = np.linspace(0, cfg.num_actors, F + 1).astype(int)
+    # the env-worker budget is a per-HOST tuning knob: split it across the
+    # fleets rather than letting each fleet spawn its own full pool (4
+    # fleets x 16 workers would 4x-oversubscribe the cores the knob was
+    # tuned for)
+    fleet_workers = (cfg.env_workers + F - 1) // F if cfg.env_workers else 0
+    actors = [
+        VectorActor(cfg, envs[lo:hi], epsilons[lo:hi], act_fn, param_store,
+                    sink=buffer.add, env_workers=fleet_workers,
+                    rng=np.random.default_rng(cfg.seed + 7919 + 104729 * f))
+        for f, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:]))
+        if lo < hi
+    ]
     return dict(envs=envs, action_dim=action_dim, net=net, learner=learner,
-                buffer=buffer, actor=actor, param_store=param_store,
+                buffer=buffer, actors=actors, actor=actors[0],
+                param_store=param_store,
                 checkpointer=checkpointer, host_bs=host_bs, ring=ring)
 
 
@@ -206,12 +223,12 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     Returns metrics incl. the per-update loss curve and episode returns.
     """
     # prefetch would run batch_source (which steps the actor) on a thread,
-    # and env workers would make block arrival order racy — both break the
-    # deterministic interleaving this function promises; device_replay's
-    # k-step dispatch granularity likewise, and a nonzero result pipeline
-    # would defer priority feedback (this path applies it after every
-    # single update)
-    cfg = cfg.replace(prefetch_batches=0, env_workers=0,
+    # and env workers / multiple fleets would make block arrival order racy
+    # — all break the deterministic interleaving this function promises;
+    # device_replay's k-step dispatch granularity likewise, and a nonzero
+    # result pipeline would defer priority feedback (this path applies it
+    # after every single update)
+    cfg = cfg.replace(prefetch_batches=0, env_workers=0, actor_fleets=1,
                       device_replay=False, superstep_pipeline=0)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     actor: VectorActor = sys["actor"]
@@ -257,8 +274,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     """The full concurrent system (reference train.py:20-44 equivalent).
 
     Threads and their reference analogues:
-      actor        — the N actor processes (worker.py:516-561), one lockstep
-                     fleet thread with batched inference
+      actor[0..F]  — the N actor processes (worker.py:516-561), regrouped
+                     into ``cfg.actor_fleets`` lockstep fleet threads with
+                     batched inference (one fleet's env stepping overlaps
+                     another's inference on multi-core hosts)
       sample       — ReplayBuffer.prepare_data (worker.py:113-122)
       priority     — ReplayBuffer.update_data (worker.py:131-138)
       log          — the buffer process's stats loop (worker.py:89-106)
@@ -276,7 +295,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     ``profile_dir`` captures a ``jax.profiler`` device trace of the run.
     """
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
-    actor: VectorActor = sys["actor"]
+    actors: List[VectorActor] = sys["actors"]
     buffer: ReplayBuffer = sys["buffer"]
     learner: Learner = sys["learner"]
     tracer = tracer or Tracer()
@@ -292,10 +311,12 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     batch_queue: "queue.Queue" = queue.Queue(maxsize=8)
     priority_queue: "queue.Queue" = queue.Queue(maxsize=8)
 
-    def actor_loop():
-        while not stop():
-            with tracer.span("actor.run256"):
-                actor.run(max_steps=256, stop=stop)
+    def make_actor_loop(a: VectorActor):
+        def actor_loop():
+            while not stop():
+                with tracer.span("actor.run256"):
+                    a.run(max_steps=256, stop=stop)
+        return actor_loop
 
     def sample_loop():
         while not stop():
@@ -357,8 +378,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                       f"loss={entry['mean_loss']:.4f}", flush=True)
             last_steps, last_time = s["training_steps"], now
 
-    loops = [("actor", actor_loop), ("sample", sample_loop),
-             ("priority", priority_loop), ("log", log_loop)]
+    loops = [(f"actor{f}" if len(actors) > 1 else "actor",
+              make_actor_loop(a)) for f, a in enumerate(actors)]
+    loops += [("sample", sample_loop), ("priority", priority_loop),
+              ("log", log_loop)]
     if sys["ring"] is not None:
         # device replay: the learner samples index bundles itself (cheap,
         # coupled to its dispatch) — no host batch-staging thread
@@ -382,6 +405,11 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 return
             except queue.Full:
                 continue
+        # stopped: the learner's exit drain still delivers its pipelined
+        # pending results through this sink, and the priority thread may
+        # already be gone — apply directly (lock-protected, order-free)
+        # instead of silently dropping them
+        buffer.update_priorities(idxes, priorities, old_ptr, loss)
 
     try:
         with device_profile(profile_dir):
@@ -395,7 +423,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     finally:
         stop_event.set()
         supervisor.join_all(timeout=5.0)
-        actor.close()
+        for a in actors:
+            a.close()
 
     # drain remaining priority feedback so buffer counters are final
     while True:

@@ -240,3 +240,34 @@ def test_host_staged_run_pipeline_depths(depth):
     assert len(sunk) == 7
     assert all(np.all(np.isfinite(p)) for _, p in sunk)
     assert np.isfinite(metrics["mean_loss"])
+
+
+def test_train_threaded_fabric_multi_fleet():
+    """actor_fleets > 1: lanes split into independent fleet threads with
+    GLOBAL ladder epsilons; the fabric trains and every fleet contributes
+    experience."""
+    from r2d2_tpu.train import _build
+    from r2d2_tpu.utils.math import epsilon_ladder
+
+    cfg = make_test_config(game_name="Fake", num_actors=4, actor_fleets=2,
+                           training_steps=6, log_interval=0.2)
+    sys_ = _build(cfg, lambda c, s: env_factory(c, s), False, None, False)
+    actors = sys_["actors"]
+    assert [a.N for a in actors] == [2, 2]
+    # lane i keeps the GLOBAL ladder epsilon regardless of fleet split
+    got = [e for a in actors for e in a.epsilons.tolist()]
+    want = [epsilon_ladder(i, 4) for i in range(4)]
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+    # every fleet genuinely produces blocks through its own sink
+    counts = [0, 0]
+    for f, a in enumerate(actors):
+        a.sink = (lambda f_: lambda *args: counts.__setitem__(
+            f_, counts[f_] + 1))(f)
+        a.run(max_steps=2 * cfg.block_length)
+    assert all(c > 0 for c in counts), counts
+
+    metrics = train(cfg, env_factory=lambda c, s: env_factory(c, s),
+                    verbose=False)
+    assert metrics["num_updates"] >= cfg.training_steps
+    assert np.isfinite(metrics["mean_loss"])
+    assert not metrics["fabric_failed"]
