@@ -9,10 +9,11 @@ checkpoint/resume, which the reference lacks.
 TPU-first redesign — one process, many threads, one device program:
 
 - The reference needs N+2 *processes* because CPython+torch actors are
-  GIL-bound.  Here actor inference is a single batched jitted call for the
-  whole fleet (r2d2_tpu/actor.py), so the fleet is one thread; JAX releases
-  the GIL during device execution, so actor inference, host batch
-  assembly, H2D prefetch, and the learner step genuinely overlap.
+  GIL-bound.  Here actor inference is a single batched jitted call per
+  fleet (r2d2_tpu/actor.py), so ``cfg.actor_fleets`` threads (default 1)
+  cover the whole lane set; JAX releases the GIL during device execution,
+  so actor inference, env stepping, host batch assembly, H2D prefetch,
+  and the learner step genuinely overlap.
 - Queues are ``queue.Queue`` handoffs between threads rather than pickle
   pipes between processes — blocks move by reference, zero-copy.
 - Weight flow is the versioned ParamStore (no shared-memory mutation).
