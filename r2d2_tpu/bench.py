@@ -26,6 +26,7 @@ with the system/actor/MFU numbers as additional fields.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -223,6 +224,9 @@ def main(steps: int = 100, warmup: int = 5,
         "system_env_frames_per_sec": round(system_fps, 1),
         "system_vs_baseline": round(system_fps / NORTH_STAR_FPS, 3),
         "actor_env_frames_per_sec": round(actor_fps, 1),
+        # the actor/system planes are host-CPU-bound work: their numbers
+        # only compare across machines with this context attached
+        "host_cpus": os.cpu_count() or 0,
     }
     if flops > 0:
         achieved = flops * steps_per_sec / 1e12

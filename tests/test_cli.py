@@ -42,8 +42,16 @@ def test_build_config_presets_and_overrides():
     assert cfg.game_name == "Pong" and cfg.num_actors == 4 and cfg.lr == 5e-5
     cfg = build_config(_Args(preset="atari57", game="Breakout"))
     assert cfg.game_name == "Breakout" and cfg.num_actors == 256
+    assert cfg.actor_fleets == 4
     cfg = build_config(_Args(preset="impala_deep"))
     assert cfg.torso == "impala" and cfg.lstm_layers == 2
+    # scaled-down --actors must clamp a preset's fleet default, not raise
+    cfg = build_config(_Args(preset="hard_exploration", actors=2))
+    assert cfg.num_actors == 2 and cfg.actor_fleets == 2
+    # ... but an explicit override wins
+    cfg = build_config(_Args(preset="hard_exploration", actors=8,
+                             overrides=[("actor_fleets", 1)]))
+    assert cfg.actor_fleets == 1
 
 
 def test_cli_train_then_eval_round_trip(tmp_path, capsys):
