@@ -1,14 +1,22 @@
 """Produce the learning-quality evidence artifact (CURVES_r{N}.json).
 
-Trains the deterministic single-process trainer on the fake env with a
-dense checkpoint cadence, then runs the evaluator's checkpoint sweep
-(reference protocol: test.py:26-58 — per-checkpoint mean reward over
-ε=0.001 episodes vs env frames) and writes the curve JSON.  The in-sandbox
-proxy for the MsPacman quality north star: ALE is not installed here, so
-the fake env's learnable POMDP (envs/fake.py) stands in — the curve must
-show reward rising from the random baseline to near-optimal.
+Trains on the fake env with a dense checkpoint cadence, then runs the
+evaluator's checkpoint sweep (reference protocol: test.py:26-58 —
+per-checkpoint mean reward over ε=0.001 episodes vs env frames) and
+writes the curve JSON.  The in-sandbox proxy for the MsPacman quality
+north star: ALE is not installed here, so the fake env's learnable POMDP
+(envs/fake.py) stands in — the curve must show reward rising from the
+random baseline to near-optimal.
 
-Run:  python tools/make_curves.py [out.json]
+Two modes:
+- default: the deterministic single-process trainer (``train_sync``) —
+  reproducible reference semantics.
+- ``--fabric``: the full threaded production fabric (``train``) with
+  device-resident replay, fused super-steps, the pipelined result
+  harvest, and two actor fleets — evidence that the concurrent system,
+  not just the deterministic interleaving, learns.
+
+Run:  python tools/make_curves.py [out.json] [--fabric]
 """
 import json
 import os
@@ -27,7 +35,7 @@ from r2d2_tpu.config import test_config  # noqa: E402
 from r2d2_tpu.envs.fake import FakeAtariEnv  # noqa: E402
 from r2d2_tpu.evaluate import evaluate_params, evaluate_sweep  # noqa: E402
 from r2d2_tpu.models.network import create_network, init_params  # noqa: E402
-from r2d2_tpu.train import train_sync  # noqa: E402
+from r2d2_tpu.train import train, train_sync  # noqa: E402
 
 A = 4
 
@@ -37,7 +45,12 @@ def env_factory(cfg, seed):
                         seed=seed, episode_len=32)
 
 
-def main(out_path: str = "CURVES_r03.json") -> None:
+def main(out_path: str = None, fabric: bool = False) -> None:
+    if out_path is None:
+        # mode-derived default so `--fabric` can never silently overwrite
+        # the deterministic-trainer evidence artifact
+        out_path = ("CURVES_FABRIC_r03.json" if fabric
+                    else "CURVES_r03.json")
     # lr is deliberately NOT the reference's 1e-4: that value is tuned for
     # Atari-scale nets and batch 64, and at this toy scale (hidden 32,
     # batch 8) it plateaus barely above random within any reasonable CPU
@@ -47,6 +60,12 @@ def main(out_path: str = "CURVES_r03.json") -> None:
         game_name="Fake", training_steps=2000, save_interval=80,
         lr=3e-3, hidden_dim=32,
         eval_episodes=5, max_episode_steps=64, seed=0)
+    if fabric:
+        # the full concurrent system: device ring + fused super-steps +
+        # pipelined harvest + two actor fleets.  save_interval stays dense
+        # (cadences fire on interval crossings, learner.py).
+        cfg = cfg.replace(num_actors=4, actor_fleets=2, device_replay=True,
+                          superstep_k=4, superstep_pipeline=2)
     ckpt_dir = os.path.join(os.path.dirname(out_path) or ".",
                             "_curves_ckpts")
     # stale checkpoints from a previous run (possibly a different arch or
@@ -54,9 +73,15 @@ def main(out_path: str = "CURVES_r03.json") -> None:
     # curve — evaluate_sweep walks every step_* in the dir
     shutil.rmtree(ckpt_dir, ignore_errors=True)
 
-    print(f"[curves] training {cfg.training_steps} updates, checkpoint "
+    print(f"[curves] training {cfg.training_steps} updates "
+          f"({'threaded fabric' if fabric else 'train_sync'}), checkpoint "
           f"every {cfg.save_interval}", flush=True)
-    train_sync(cfg, env_factory=env_factory, checkpoint_dir=ckpt_dir)
+    if fabric:
+        metrics = train(cfg, env_factory=env_factory,
+                        checkpoint_dir=ckpt_dir, verbose=False)
+        assert not metrics["fabric_failed"], "fabric reported a failure"
+    else:
+        train_sync(cfg, env_factory=env_factory, checkpoint_dir=ckpt_dir)
 
     # random-policy baseline for context (fresh params, eval epsilon)
     net = create_network(cfg, A)
@@ -71,9 +96,19 @@ def main(out_path: str = "CURVES_r03.json") -> None:
                  "(reference test.py:26-58 semantics on the fake-env "
                  "stand-in; ALE absent in this image)",
         env="FakeAtariEnv learnable POMDP (envs/fake.py)",
+        trainer=(f"threaded fabric: device_replay={cfg.device_replay}, "
+                 f"superstep_k={cfg.superstep_k}, "
+                 f"pipeline={cfg.superstep_pipeline}, "
+                 f"{cfg.actor_fleets} actor fleets" if fabric
+                 else "train_sync (deterministic)"),
         config=dict(training_steps=cfg.training_steps,
                     save_interval=cfg.save_interval,
-                    batch_size=cfg.batch_size, seed=cfg.seed),
+                    batch_size=cfg.batch_size, seed=cfg.seed,
+                    num_actors=cfg.num_actors,
+                    actor_fleets=cfg.actor_fleets,
+                    device_replay=cfg.device_replay,
+                    superstep_k=cfg.superstep_k,
+                    superstep_pipeline=cfg.superstep_pipeline),
         random_policy_reward=float(rand),
         curve=curve,
     )
@@ -93,4 +128,5 @@ def main(out_path: str = "CURVES_r03.json") -> None:
 
 
 if __name__ == "__main__":
-    main(*(sys.argv[1:2] or ["CURVES_r03.json"]))
+    args = [a for a in sys.argv[1:] if a != "--fabric"]
+    main(args[0] if args else None, fabric="--fabric" in sys.argv[1:])
