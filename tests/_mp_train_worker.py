@@ -44,7 +44,9 @@ from r2d2_tpu.train import train  # noqa: E402
 
 cfg = test_config(game_name="Fake", device_replay=DEVICE_REPLAY,
                   superstep_k=2,
-                  training_steps=6, log_interval=0.3, num_actors=2,
+                  superstep_pipeline=2,  # multihost pipelined harvest +
+                                         # exit drain must stay deadlock-free
+                  training_steps=8, log_interval=0.3, num_actors=2,
                   weight_publish_interval=2,  # force publishes mid-run
                   mesh_shape=(("dp", 4), ("mp", 2)))
 m = train(cfg, env_factory=lambda c, s: FakeAtariEnv(

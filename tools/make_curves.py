@@ -105,10 +105,13 @@ def main(out_path: str = None, fabric: bool = False) -> None:
                     save_interval=cfg.save_interval,
                     batch_size=cfg.batch_size, seed=cfg.seed,
                     num_actors=cfg.num_actors,
-                    actor_fleets=cfg.actor_fleets,
-                    device_replay=cfg.device_replay,
-                    superstep_k=cfg.superstep_k,
-                    superstep_pipeline=cfg.superstep_pipeline),
+                    # fabric knobs only when the fabric ran them —
+                    # train_sync forces pipeline 0 / no supersteps
+                    **(dict(actor_fleets=cfg.actor_fleets,
+                            device_replay=cfg.device_replay,
+                            superstep_k=cfg.superstep_k,
+                            superstep_pipeline=cfg.superstep_pipeline)
+                       if fabric else {})),
         random_policy_reward=float(rand),
         curve=curve,
     )
