@@ -193,9 +193,59 @@ def _system_bench(wall_seconds: float, *, device_replay: bool = True,
     return frames_per_sec, top_spans, metrics.get("num_updates", 0)
 
 
+def _device_probe(timeout_s: float = 240.0):
+    """Check the accelerator backend answers at all, from a subprocess.
+
+    The tunneled TPU backend can wedge indefinitely on a stale device
+    claim (backend init then never returns); probing in a bounded
+    subprocess turns that failure mode into a parseable artifact line
+    instead of a silent driver-side timeout with no JSON at all.  A
+    healthy probe exits cleanly, so its own claim is released.
+
+    Returns ``(ok, reason)`` — reason distinguishes a genuine timeout
+    from a fast failure and carries the child's stderr tail so the
+    artifact reports the real error, not a guessed one."""
+    import subprocess
+
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        try:
+            _, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                # bounded reap: a child wedged in an uninterruptible
+                # driver call may be unkillable — leak it rather than
+                # recreate the indefinite no-artifact hang
+                proc.communicate(timeout=10.0)
+            except Exception:
+                pass
+            return False, ("device probe timed out — tunneled chip claim "
+                           "may be wedged")
+        if proc.returncode == 0:
+            return True, ""
+        tail = (err or b"").decode(errors="replace").strip().splitlines()
+        return False, (f"device probe failed (rc={proc.returncode}): "
+                       + " | ".join(tail[-3:]))
+    except Exception as e:
+        return False, f"device probe error: {type(e).__name__}: {e}"
+
+
 def main(steps: int = 100, warmup: int = 5,
          system_seconds: float = 75.0) -> None:
     import traceback
+
+    ok, reason = _device_probe()
+    if not ok:
+        print(json.dumps({
+            "metric": "learner_env_frames_per_sec",
+            "value": -1.0, "unit": "frames/s", "vs_baseline": -1.0,
+            "error": f"accelerator backend unreachable ({reason})",
+        }))
+        sys.exit(1)
 
     import jax
 

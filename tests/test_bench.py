@@ -10,6 +10,8 @@ import numpy as np
 def test_bench_main_survives_actor_and_system_crash(monkeypatch, capsys):
     from r2d2_tpu import bench
 
+    # the real probe would spawn a subprocess against the default backend
+    monkeypatch.setattr(bench, "_device_probe", lambda *a, **k: (True, ""))
     monkeypatch.setattr(bench, "_learner_micro_bench",
                         lambda steps, warmup: (123456.0, 42.0, 1e9))
 
@@ -33,6 +35,7 @@ def test_bench_json_line_is_first_stdout_line(monkeypatch, capsys):
     """The driver parses stdout for ONE JSON line; nothing may precede it."""
     from r2d2_tpu import bench
 
+    monkeypatch.setattr(bench, "_device_probe", lambda *a, **k: (True, ""))
     monkeypatch.setattr(bench, "_learner_micro_bench",
                         lambda steps, warmup: (50000.0, 10.0, 0.0))
     monkeypatch.setattr(bench, "_actor_plane_bench", lambda: 1.0)
@@ -43,3 +46,21 @@ def test_bench_json_line_is_first_stdout_line(monkeypatch, capsys):
     parsed = json.loads(lines[0])
     assert parsed["vs_baseline"] == 1.0
     assert np.isclose(parsed["system_env_frames_per_sec"], 2.0)
+
+
+def test_bench_reports_unreachable_device_as_artifact(monkeypatch, capsys):
+    """A wedged accelerator backend must yield a parseable JSON line (and
+    a nonzero exit) rather than an indefinite hang with no artifact."""
+    import pytest
+
+    from r2d2_tpu import bench
+
+    monkeypatch.setattr(bench, "_device_probe",
+                    lambda *a, **k: (False, "probe timed out"))
+    with pytest.raises(SystemExit) as ex:
+        bench.main(steps=1, warmup=0, system_seconds=0.1)
+    assert ex.value.code == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    result = json.loads(out[0])
+    assert result["value"] == -1.0
+    assert "unreachable" in result["error"]
