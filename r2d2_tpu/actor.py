@@ -201,19 +201,24 @@ class VectorActor:
         self.finish_pending[i] = False
 
     def _refresh_params(self) -> None:
+        if self._act_device is not None:
+            # actor inference runs on the CPU backend: the reference's
+            # actors hold CPU model copies (worker.py:504-507), and on an
+            # accelerator learner this keeps the per-env-step
+            # dispatch+q-fetch off the device interconnect entirely.  One
+            # params transfer per refresh (every actor_update_interval
+            # steps) replaces a round trip per env step — and the placed
+            # copy is CACHED per published version, so a multi-fleet
+            # actor plane pays the device→host wire transfer once per
+            # publish, not once per fleet.
+            version, params = self.param_store.get_placed(self._act_device)
+            if params is not None and version != self._param_version:
+                self._params = params
+                self._param_version = version
+            return
         version, params = self.param_store.get()
         if params is not None and version != self._param_version:
-            if self._act_device is not None:
-                # actor inference runs on the CPU backend: the reference's
-                # actors hold CPU model copies (worker.py:504-507), and on
-                # an accelerator learner this keeps the per-env-step
-                # dispatch+q-fetch off the device interconnect entirely.
-                # One params transfer per refresh (every
-                # actor_update_interval steps) replaces a round trip per
-                # env step.
-                params = jax.device_put(params, self._act_device)
-            elif isinstance(
-                    jax.tree.leaves(params)[0], np.ndarray):
+            if isinstance(jax.tree.leaves(params)[0], np.ndarray):
                 # multi-host publishes HOST arrays (learner._publish) so
                 # actor jits stay process-local; commit them to one local
                 # device per refresh rather than re-uploading every call

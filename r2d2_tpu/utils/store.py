@@ -18,6 +18,7 @@ class ParamStore:
         self._lock = threading.Lock()
         self._version = 0 if params is None else 1
         self._params = params
+        self._placed: dict = {}  # device -> (version, placed params)
 
     def publish(self, params: Any) -> int:
         """Swap in a new snapshot; returns its version (monotonic from 1)."""
@@ -30,3 +31,31 @@ class ParamStore:
         """Latest ``(version, params)``; params is None until first publish."""
         with self._lock:
             return self._version, self._params
+
+    def get_placed(self, device: Any) -> Tuple[int, Any]:
+        """Latest ``(version, params placed on device)``, computing the
+        placement once per (version, device) and sharing it.
+
+        Consumers that need the snapshot on a specific backend — actor
+        fleets pulling learner weights to the host CPU — would otherwise
+        each pay the same device→host transfer per refresh; on a tunneled
+        accelerator that is the whole parameter set across the wire per
+        fleet.  The transfer runs outside the lock so a slow interconnect
+        never blocks ``publish``/``get``; concurrent same-version callers
+        may race the transfer (placing twice, last one cached) rather
+        than serialise on it.
+        """
+        import jax
+
+        with self._lock:
+            version, params = self._version, self._params
+            cached = self._placed.get(device)
+            if cached is not None and cached[0] == version:
+                return cached
+        if params is not None:
+            params = jax.device_put(params, device)
+        entry = (version, params)
+        with self._lock:
+            if self._version == version:  # don't cache a stale snapshot
+                self._placed[device] = entry
+        return entry

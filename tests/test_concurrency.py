@@ -137,3 +137,28 @@ def test_paramstore_concurrent_publish_get_versions_monotonic():
     for t in threads:
         t.join(3.0)
     assert not errors, errors[:1]
+
+
+def test_param_store_placed_cache_shared_across_consumers():
+    """get_placed computes one placement per (version, device) and shares
+    it — the multi-fleet actor plane must not pay one transfer per fleet."""
+    import jax
+
+    from r2d2_tpu.utils.store import ParamStore
+
+    dev = jax.devices("cpu")[0]
+    store = ParamStore()
+    v0, p0 = store.get_placed(dev)
+    assert v0 == 0 and p0 is None  # nothing published yet
+
+    store.publish({"w": jax.numpy.ones((4,))})
+    v1, p1 = store.get_placed(dev)
+    v1b, p1b = store.get_placed(dev)
+    assert v1 == v1b == 1
+    assert p1 is p1b  # cached object, not a fresh transfer
+
+    store.publish({"w": jax.numpy.zeros((4,))})
+    v2, p2 = store.get_placed(dev)
+    assert v2 == 2 and p2 is not p1
+    import numpy as np
+    np.testing.assert_array_equal(np.asarray(p2["w"]), 0.0)
