@@ -1,0 +1,170 @@
+"""One-shot TPU measurement battery for tuning the fabric on real hardware.
+
+Run on the TPU host when the accelerator is healthy:
+
+    python tools/measure_tpu.py
+
+Order: cheap probes first, long system benches last, everything bounded by
+internal budgets — do NOT kill this process externally on a tunneled chip
+(a hard-killed client can wedge the remote device claim for hours; see
+bench.py:_device_probe).
+
+``--quick`` runs a CPU-sized smoke of sections 1-3 (tiny config, CPU pin)
+to validate the battery itself without an accelerator.
+
+What it answers, in order:
+1. Does ``copy_to_host_async`` actually prefetch on this backend (the
+   premise of the superstep_pipeline latency-hiding — learner loops
+   degrade to one blocking round trip per dispatch without it)?
+2. Forward-unroll wall time at B=64 vs B=128: if the ratio is well under
+   2, fusing the online+target unrolls into one double-batch pass would
+   pay; if ~2 the MXU is already saturated and fusion is pointless.
+3. The learner micro number (the headline metric).
+4. The full-system number across (superstep_k, superstep_pipeline)
+   candidates — pick bench.py's defaults from this, not from guesses.
+5. The actor plane.
+"""
+import sys
+import time
+
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUICK = "--quick" in sys.argv[1:]
+if QUICK:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import Config, test_config
+from r2d2_tpu.learner.step import create_train_state, jit_train_step
+from r2d2_tpu.models.network import R2D2Network, create_network, init_params
+from r2d2_tpu.utils.batch import synthetic_batch
+
+
+def main(quick: bool = False) -> None:
+    print("devices:", jax.devices(), flush=True)
+    cfg = Config() if not quick else test_config()
+    A = 9 if not quick else 4  # MsPacman minimal action set
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+
+    # --- 1. copy_to_host_async support + effect ---
+    # Controlled A/B: both arms fetch AFTER compute has settled (same
+    # sleep), differing only in whether the host copy was started early.
+    # Comparing a prefetched fetch against the dispatch+compute+fetch
+    # round trip instead would declare "prefetch works" on any backend,
+    # because excluding compute alone makes the number drop.
+    f = jax.jit(lambda a: a @ a + 1.0)
+    m = f(jnp.ones((512, 512)))
+    np.asarray(m)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        np.asarray(f(m))
+    rtt = (time.perf_counter() - t0) / 10 * 1000
+    print(f"dispatch+compute+fetch round trip: {rtt:.1f} ms", flush=True)
+
+    def settled_fetch_ms(prefetch: bool) -> float:
+        total = 0.0
+        for _ in range(10):
+            r = f(m)
+            if prefetch:
+                r.copy_to_host_async()
+            time.sleep(max(0.05, 2 * rtt / 1000))
+            t1 = time.perf_counter()
+            np.asarray(r)
+            total += time.perf_counter() - t1
+        return total / 10 * 1000
+
+    try:
+        control = settled_fetch_ms(False)
+        with_copy = settled_fetch_ms(True)
+        print(f"settled fetch: control {control:.2f} ms, after "
+              f"copy_to_host_async {with_copy:.2f} ms "
+              "(prefetch helps iff the second is clearly smaller)",
+              flush=True)
+    except Exception as e:
+        print(f"copy_to_host_async: UNSUPPORTED ({type(e).__name__}: {e})",
+              flush=True)
+
+    # --- 2. fwd unroll batch-scaling ratio ---
+    def time_fwd(B, reps=20):
+        rng = np.random.default_rng(0)
+        obs = jnp.asarray(rng.integers(
+            0, 256, (B, cfg.seq_len, *cfg.stored_obs_shape), dtype=np.uint8))
+        la = jnp.zeros((B, cfg.seq_len, A), jnp.float32)
+        lr = jnp.zeros((B, cfg.seq_len), jnp.float32)
+        h = jnp.zeros((B, 2, cfg.lstm_layers, cfg.hidden_dim), jnp.float32)
+        fwd = jax.jit(lambda p, o, a_, r_, h_: net.apply(
+            p, o, a_, r_, h_, method=R2D2Network.unroll)[0])
+        q = fwd(params, obs, la, lr, h)
+        np.asarray(q[0, 0])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            q = fwd(params, obs, la, lr, h)
+        np.asarray(q[0, 0])
+        return (time.perf_counter() - t0) / reps * 1000
+
+    B1, B2 = (64, 128) if not quick else (4, 8)
+    t64, t128 = time_fwd(B1), time_fwd(B2)
+    print(f"fwd unroll: B={B1} {t64:.1f} ms  B={B2} {t128:.1f} ms  "
+          f"ratio {t128 / t64:.2f} (double-unroll fusion pays if << 2)",
+          flush=True)
+
+    # --- 3. learner micro — the EXACT headline measurement from bench.py
+    # (AOT compile, finite-loss guard), not a drifting reimplementation.
+    # quick mode times a few steps of the tiny-config step inline instead
+    # (bench's helper hardcodes the flagship Config).
+    if quick:
+        state = create_train_state(cfg, params)
+        step_fn = jit_train_step(cfg, net)
+        batch = {k: jax.device_put(v) for k, v in
+                 synthetic_batch(cfg, A, np.random.default_rng(0)).items()}
+        for _ in range(5):
+            state, loss, p_ = step_fn(state, batch)
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            state, loss, p_ = step_fn(state, batch)
+        float(jax.device_get(loss))
+        dt = time.perf_counter() - t0
+        print(f"learner micro (quick cfg): {5 / dt:.1f} steps/s", flush=True)
+        print("QUICK SMOKE DONE (sections 4-5 need the real chip)",
+              flush=True)
+        return
+
+    from r2d2_tpu.bench import _learner_micro_bench
+
+    fps, sps, flops = _learner_micro_bench(steps=100, warmup=5)
+    print(f"learner micro: {sps:.1f} steps/s = {fps:,.0f} frames/s "
+          f"(flops/step={flops:.3e})", flush=True)
+
+    # --- 4. system bench grid — tune_system's sweep with this battery's
+    # candidate cells (shared measurement + persisted JSON, no drift)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import tune_system
+
+    tune_system.main(seconds=60.0, grid=[
+        (True, 16, 64, 0, 2),
+        (True, 32, 64, 0, 2),
+        (True, 16, 64, 0, 1),
+    ])
+
+    # --- 5. actor plane ---
+    from r2d2_tpu.bench import _actor_plane_bench
+
+    try:
+        print(f"actor plane: {_actor_plane_bench():,.0f} frames/s",
+              flush=True)
+    except Exception as e:
+        print(f"actor plane FAILED: {type(e).__name__}: {e}", flush=True)
+    print("ALL DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main(quick=QUICK)

@@ -32,11 +32,12 @@ GRID = [
 ]
 
 
-def main(seconds: float = 60.0) -> None:
+def main(seconds: float = 60.0, grid=None) -> None:
     print(f"{'replay':>7} {'k':>3} {'actors':>6} {'workers':>7} {'pipe':>4} "
           f"{'frames/s':>12} {'updates':>8}  busiest_span")
     results = []
-    for device_replay, k, actors, workers, pipe in GRID:
+    for device_replay, k, actors, workers, pipe in (GRID if grid is None
+                                                    else grid):
         try:
             fps, top_spans, updates = _system_bench(
                 seconds, device_replay=device_replay, superstep_k=k,
