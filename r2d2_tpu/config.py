@@ -77,6 +77,10 @@ class Config:
     param_dtype: str = "float32"
     remat: bool = False               # rematerialise the LSTM scan (long seq)
     lstm_impl: str = "auto"           # "auto" | "scan" | "pallas" (ops/lstm.py)
+                                      # | "pallas_spmd" (the fused kernel
+                                      # under dp meshes via shard_map —
+                                      # explicit opt-in; "auto" meshes use
+                                      # the scan recurrence)
     pallas_interpret: bool = False    # run pallas kernels interpreted (CPU tests)
     mesh_shape: Tuple[Tuple[str, int], ...] = ()  # e.g. (("dp", 4), ("mp", 2))
     prefetch_batches: int = 4         # reference staging list depth, worker.py:312
@@ -181,7 +185,8 @@ class Config:
             raise ValueError(f"unknown torso {self.torso!r}")
         if self.lstm_layers < 1:
             raise ValueError("lstm_layers must be >= 1")
-        if self.lstm_impl not in ("auto", "scan", "pallas"):
+        if self.lstm_impl not in ("auto", "scan", "pallas",
+                          "pallas_spmd"):
             raise ValueError(f"unknown lstm_impl {self.lstm_impl!r}")
         if self.obs_space_to_depth:
             h, w, _ = self.obs_shape
@@ -193,10 +198,10 @@ class Config:
                 raise ValueError(
                     "obs_space_to_depth is for the nature/mlp torsos; the "
                     "impala torso consumes raw frames")
-        if self.lstm_impl == "pallas" and self.remat:
+        if self.lstm_impl in ("pallas", "pallas_spmd") and self.remat:
             raise ValueError(
-                "lstm_impl='pallas' cannot honour remat=True (the fused "
-                "kernel always materialises its residuals); use "
+                f"lstm_impl={self.lstm_impl!r} cannot honour remat=True "
+                "(the fused kernel always materialises its residuals); use "
                 "lstm_impl='auto' or 'scan' for rematerialised long unrolls")
 
     def replace(self, **kw) -> "Config":

@@ -136,6 +136,13 @@ class LSTMLayer(nn.Module):
     remat: bool = False
     impl: str = "scan"
     interpret: bool = False
+    # when set (a jax.sharding.Mesh with a "dp" axis), the pallas unroll
+    # runs inside shard_map over dp: each device executes the fused kernel
+    # on its batch shard with replicated weights — keeping the kernel's
+    # VMEM-residency win under data-parallel meshes, where a plain
+    # pallas_call cannot be GSPMD-partitioned.  The weight cotangent's
+    # cross-shard psum falls out of the shard_map transpose (in_spec P()).
+    spmd_mesh: Any = None
 
     @nn.compact
     def __call__(self, xs, h0, c0):
@@ -188,7 +195,20 @@ class LSTMLayer(nn.Module):
         # scan-impl network instead (actor.make_act_fn builds that twin;
         # the two impls declare identical parameters).
         if self.impl == "pallas":
-            hs, h, c = run_pallas(x_proj, wh, h0f, c0f)
+            if self.spmd_mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                # check_vma=False: pallas_call's out_shapes carry no vma
+                # annotation; correctness (incl. the wh-cotangent psum) is
+                # pinned against the scan path in tests/test_parallel.py::
+                # test_pallas_spmd_sharded_step_matches_scan
+                hs, h, c = jax.shard_map(
+                    run_pallas, mesh=self.spmd_mesh,
+                    in_specs=(P("dp"), P(), P("dp"), P("dp")),
+                    out_specs=(P("dp"), P("dp"), P("dp")),
+                    check_vma=False)(x_proj, wh, h0f, c0f)
+            else:
+                hs, h, c = run_pallas(x_proj, wh, h0f, c0f)
         else:
             hs, h, c = run_scan(x_proj, wh, h0f, c0f)
         return hs, (h, c)
@@ -221,6 +241,9 @@ class R2D2Network(nn.Module):
     """
     action_dim: int
     cfg: Config
+    # Mesh for the pallas_spmd recurrence (see LSTMLayer.spmd_mesh); set
+    # by parallel.mesh._mesh_net, None everywhere else
+    spmd_mesh: Any = None
 
     def setup(self):
         cfg = self.cfg
@@ -233,10 +256,16 @@ class R2D2Network(nn.Module):
             torso_kw["s2d_input"] = cfg.obs_space_to_depth
         self.torso = torso_cls(**torso_kw)
         impl = resolve_lstm_impl(cfg)
+        spmd = None
+        if impl == "pallas_spmd":
+            # without a mesh (single-device jits, actor twins) the fused
+            # kernel runs plain — pallas_spmd only changes mesh behavior
+            impl, spmd = "pallas", self.spmd_mesh
         self.lstm_layers_ = [
             LSTMLayer(hidden_dim=cfg.hidden_dim, compute_dtype=cd,
                       param_dtype=pd, remat=cfg.remat, impl=impl,
-                      interpret=cfg.pallas_interpret, name=f"lstm_{i}")
+                      interpret=cfg.pallas_interpret, spmd_mesh=spmd,
+                      name=f"lstm_{i}")
             for i in range(cfg.lstm_layers)
         ]
         self.head = DuelingHead(hidden_dim=cfg.hidden_dim,
@@ -284,9 +313,11 @@ def resolve_lstm_impl(cfg: Config) -> str:
     FLOPs for memory by not materialising the scan carries, while the
     Pallas kernel always streams its full residuals (hs/cs/gates) to HBM —
     for long-unroll configs that need remat to fit, the scan is the right
-    engine.
+    engine.  ``pallas_spmd`` is explicit-only (never chosen by ``auto``):
+    under a dp mesh the fused kernel runs per-device inside shard_map
+    (parallel.mesh._mesh_net); everywhere else it behaves like ``pallas``.
 
-    Both implementations declare identical parameters, so checkpoints and
+    All implementations declare identical parameters, so checkpoints and
     param pytrees are interchangeable between them (e.g. train with pallas
     on TPU, evaluate with scan on CPU).
     """
@@ -297,8 +328,9 @@ def resolve_lstm_impl(cfg: Config) -> str:
     return "pallas" if jax.default_backend() == "tpu" else "scan"
 
 
-def create_network(cfg: Config, action_dim: int) -> R2D2Network:
-    return R2D2Network(action_dim=action_dim, cfg=cfg)
+def create_network(cfg: Config, action_dim: int,
+                   spmd_mesh: Any = None) -> R2D2Network:
+    return R2D2Network(action_dim=action_dim, cfg=cfg, spmd_mesh=spmd_mesh)
 
 
 def init_params(cfg: Config, net: R2D2Network, key: jax.Array):

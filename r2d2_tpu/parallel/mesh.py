@@ -151,7 +151,7 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
     if "mp" in mesh.axis_names and state_template is None:
         raise ValueError("an mp mesh needs state_template to derive "
                          "per-parameter shardings")
-    step = make_train_step(cfg, _mesh_net(cfg, net))
+    step = make_train_step(cfg, _mesh_net(cfg, net, mesh))
     repl = replicated(mesh)
     dp = NamedSharding(mesh, P("dp"))
     st_shard = (state_shardings(mesh, state_template)
@@ -165,19 +165,40 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
     )
 
 
-def _mesh_net(cfg: Config, net: R2D2Network) -> R2D2Network:
-    """The network variant a mesh-compiled step must use (the fused Pallas
-    LSTM is a single-device program GSPMD cannot partition; "auto" falls
-    back to the scan recurrence — identical params — while an explicit
-    request is an error)."""
+def _mesh_net(cfg: Config, net: R2D2Network,
+              mesh: Optional[Mesh] = None) -> R2D2Network:
+    """The network variant a mesh-compiled step must use.
+
+    The fused Pallas LSTM is a single-device program GSPMD cannot
+    partition, so under a mesh:
+
+    - ``lstm_impl="pallas_spmd"`` (explicit opt-in) keeps the fused
+      kernel by running it per-device inside ``shard_map`` over ``dp``
+      (models/network.py:LSTMLayer.spmd_mesh) — dp-only meshes, since an
+      mp-sharded recurrent kernel would split the 4H gate dim the kernel
+      needs whole.
+    - ``"auto"`` falls back to the scan recurrence — identical params.
+    - an explicit ``"pallas"`` request is an error.
+    """
     from r2d2_tpu.models.network import create_network, resolve_lstm_impl
 
-    if resolve_lstm_impl(cfg) != "pallas":
+    resolved = resolve_lstm_impl(cfg)
+    if resolved == "pallas_spmd":
+        if mesh is not None and "mp" in mesh.axis_names and (
+                mesh.shape["mp"] > 1):
+            raise ValueError(
+                "lstm_impl='pallas_spmd' supports dp-only meshes: an "
+                "mp-sharded recurrent kernel would split the 4H gate dim "
+                "the fused kernel needs whole; use lstm_impl='auto'/'scan' "
+                "for mp meshes")
+        return create_network(cfg, net.action_dim, spmd_mesh=mesh)
+    if resolved != "pallas":
         return net
     if cfg.lstm_impl == "pallas":
         raise ValueError(
             "lstm_impl='pallas' cannot run under a mesh (GSPMD cannot "
-            "partition the fused kernel); use lstm_impl='auto' or 'scan'")
+            "partition the fused kernel); use lstm_impl='auto', 'scan', "
+            "or 'pallas_spmd'")
     return create_network(cfg.replace(lstm_impl="scan"), net.action_dim)
 
 
@@ -245,7 +266,8 @@ def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
                 in_specs=(P("dp"), P("dp"), P("dp")),
                 out_specs=P("dp"))(arrays, ints_t, w_t)
 
-    fn = make_super_step_fn(cfg, _mesh_net(cfg, net), k, gather=gather)
+    fn = make_super_step_fn(cfg, _mesh_net(cfg, net, mesh), k,
+                            gather=gather)
     repl = replicated(mesh)
     dp_b = NamedSharding(mesh, P(None, "dp"))
     st_shard = (state_shardings(mesh, state_template)

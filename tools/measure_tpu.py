@@ -153,7 +153,7 @@ def main(quick: bool = False) -> None:
         (True, 16, 64, 0, 2),
         (True, 32, 64, 0, 2),
         (True, 16, 64, 0, 1),
-    ])
+    ], out="measure_tpu_grid.json")  # never clobber a full sweep's JSON
 
     # --- 5. actor plane ---
     from r2d2_tpu.bench import _actor_plane_bench

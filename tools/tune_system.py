@@ -32,7 +32,8 @@ GRID = [
 ]
 
 
-def main(seconds: float = 60.0, grid=None) -> None:
+def main(seconds: float = 60.0, grid=None,
+         out: str = "tune_system_results.json") -> None:
     print(f"{'replay':>7} {'k':>3} {'actors':>6} {'workers':>7} {'pipe':>4} "
           f"{'frames/s':>12} {'updates':>8}  busiest_span")
     results = []
@@ -56,9 +57,9 @@ def main(seconds: float = 60.0, grid=None) -> None:
                             busiest=top))
         print(f"{'dev' if device_replay else 'host':>7} {k:>3} {actors:>6} "
               f"{workers:>7} {pipe:>4} {fps:>12,.0f} {updates:>8}  {top}")
-    with open("tune_system_results.json", "w") as f:
+    with open(out, "w") as f:
         json.dump(results, f, indent=1)
-    print("→ tune_system_results.json")
+    print(f"→ {out}")
 
 
 if __name__ == "__main__":
