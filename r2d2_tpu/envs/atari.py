@@ -41,6 +41,31 @@ def atari_available() -> bool:
     return _HAS_ALE
 
 
+class SeedFirstReset:
+    """Thread the lane seed into the wrapped env's FIRST ``reset``
+    (gymnasium's seeding API is reset-time).  Without it only the noop
+    RNG was seeded and the underlying ALE stream drew from OS entropy —
+    real-Atari runs were irreproducible even with a fixed config seed.
+    Subsequent resets deliberately pass no seed: reseeding every episode
+    would replay the identical episode forever."""
+
+    def __init__(self, env, seed: Optional[int]):
+        self.env = env
+        self._seed = seed
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    def reset(self, **kwargs):
+        if self._seed is not None:
+            kwargs.setdefault("seed", self._seed)
+            self._seed = None
+        return self.env.reset(**kwargs)
+
+    def step(self, action):
+        return self.env.step(action)
+
+
 class NoopResetEnv:
     """1..noop_max random no-op steps at reset (environment.py:8-35).
 
@@ -159,6 +184,7 @@ def create_env(cfg: Config, noop_start: bool = True,
         f"ALE/{cfg.game_name}-v5", obs_type="grayscale",
         frameskip=cfg.frameskip, repeat_action_probability=0.0,
         full_action_space=False)
+    env = SeedFirstReset(env, seed)
     env = WarpFrame(env, width=cfg.obs_shape[1], height=cfg.obs_shape[0])
     if noop_start:
         env = NoopResetEnv(env, noop_max=cfg.noop_max,

@@ -165,8 +165,7 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
     )
 
 
-def _mesh_net(cfg: Config, net: R2D2Network,
-              mesh: Optional[Mesh] = None) -> R2D2Network:
+def _mesh_net(cfg: Config, net: R2D2Network, mesh: Mesh) -> R2D2Network:
     """The network variant a mesh-compiled step must use.
 
     The fused Pallas LSTM is a single-device program GSPMD cannot
@@ -184,8 +183,7 @@ def _mesh_net(cfg: Config, net: R2D2Network,
 
     resolved = resolve_lstm_impl(cfg)
     if resolved == "pallas_spmd":
-        if mesh is not None and "mp" in mesh.axis_names and (
-                mesh.shape["mp"] > 1):
+        if "mp" in mesh.axis_names and mesh.shape["mp"] > 1:
             raise ValueError(
                 "lstm_impl='pallas_spmd' supports dp-only meshes: an "
                 "mp-sharded recurrent kernel would split the 4H gate dim "
