@@ -18,4 +18,4 @@ from r2d2_tpu.config import (
 )
 from r2d2_tpu.train import train, train_sync
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
