@@ -16,6 +16,8 @@ from r2d2_tpu.config import (
     impala_deep_config,
     test_config,
 )
+from r2d2_tpu.checkpoint import Checkpointer
+from r2d2_tpu.evaluate import evaluate_params, evaluate_sweep
 from r2d2_tpu.train import train, train_sync
 
 __version__ = "0.3.0"
