@@ -285,8 +285,8 @@ class Learner:
                 for arr in (loss, priorities):
                     try:
                         arr.copy_to_host_async()
-                    except (AttributeError, NotImplementedError):
-                        pass  # backend without the API: harvest pays the trip
+                    except Exception:
+                        pass  # any prefetch failure: harvest pays the trip
                 pending.append((host, loss, priorities))
                 while len(pending) > cfg.superstep_pipeline:
                     harvest(pending.popleft())
@@ -396,8 +396,8 @@ class Learner:
             flat = jnp.concatenate([losses, priorities.reshape(-1)])
             try:
                 flat.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass  # backend without the API: harvest pays the round trip
+            except Exception:
+                pass  # any prefetch failure: harvest pays the round trip
             return (meta, flat)
 
         def harvest(item) -> None:
@@ -606,8 +606,8 @@ class Learner:
             for arr in (losses, priorities):
                 try:
                     arr.copy_to_host_async()
-                except (AttributeError, NotImplementedError):
-                    pass  # backend without the API: harvest pays the trip
+                except Exception:
+                    pass  # any prefetch failure: harvest pays the trip
             return item
 
         def harvest(item) -> None:

@@ -44,10 +44,17 @@ def _build() -> Optional[str]:
     # (SOURCE_DATE_EPOCH) and same-second edits, silently loading stale code
     out = os.path.join(_cache_dir(), f"sumtree_{digest}.so")
     if os.path.exists(out):
+        # only trust a cached .so we own: a writable shared cache path must
+        # not let a pre-planted file be ctypes-loaded into the process
+        try:
+            if os.stat(out).st_uid != os.getuid():
+                return None
+        except OSError:
+            return None
         return out
     cc = os.environ.get("CC", "cc")
     try:
-        os.makedirs(_cache_dir(), exist_ok=True)
+        os.makedirs(_cache_dir(), mode=0o700, exist_ok=True)
         tmp = out + f".tmp{os.getpid()}"
         subprocess.run([cc, "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
                        check=True, capture_output=True, timeout=60)
@@ -105,6 +112,13 @@ def st_update(nodes: np.ndarray, num_levels: int, leaf_offset: int,
         return False
     idxes = np.ascontiguousarray(idxes, dtype=np.int64)
     prios = np.ascontiguousarray(prios, dtype=np.float64)
+    leaf_count = nodes.size - leaf_offset
+    if idxes.size and (int(idxes.min()) < 0 or int(idxes.max()) >= leaf_count):
+        # match the numpy path's IndexError instead of letting the C loop
+        # write outside the nodes heap
+        raise IndexError(
+            f"sum-tree leaf index out of range [0, {leaf_count}): "
+            f"[{int(idxes.min())}, {int(idxes.max())}]")
     lib.st_update(_ptr_f64(nodes), num_levels, leaf_offset,
                   _ptr_i64(idxes), _ptr_f64(prios), idxes.size)
     return True
@@ -129,4 +143,7 @@ def st_prefix_mass(nodes: np.ndarray, leaf_offset: int,
     lib = _load()
     if lib is None:
         return None
+    if not 0 <= leaf_idx <= nodes.size - leaf_offset:
+        raise IndexError(f"prefix_mass leaf index {leaf_idx} out of range "
+                         f"[0, {nodes.size - leaf_offset}]")
     return float(lib.st_prefix_mass(_ptr_f64(nodes), leaf_offset, leaf_idx))

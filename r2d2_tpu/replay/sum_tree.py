@@ -49,6 +49,14 @@ class SumTree:
         idxes = np.asarray(idxes, dtype=np.int64)
         if idxes.size == 0:
             return
+        leaf_count = self.nodes.size - self.leaf_offset
+        if int(idxes.min()) < 0 or int(idxes.max()) >= leaf_count:
+            # shared by both backends: the numpy path would otherwise
+            # silently overwrite ancestor sums via negative indexing, the C
+            # path write outside the nodes heap
+            raise IndexError(
+                f"sum-tree leaf index out of range [0, {leaf_count}): "
+                f"[{int(idxes.min())}, {int(idxes.max())}]")
         prios = np.asarray(td_errors, dtype=np.float64) ** self.prio_exponent
         if native.st_update(self.nodes, self.num_levels, self.leaf_offset,
                             idxes, prios):
@@ -105,6 +113,8 @@ class SumTree:
         """Total priority mass of all leaves strictly before ``leaf_idx``
         (O(log n) root walk)."""
         leaf_idx = int(leaf_idx)
+        if leaf_idx < 0:
+            raise IndexError(f"prefix_mass leaf index {leaf_idx} < 0")
         if leaf_idx >= self.leaf_offset + 1:
             # every leaf is strictly before: the root walk below (and its C
             # port) would start one node past the array when the leaf layer

@@ -25,6 +25,10 @@ class ParamStore:
         with self._lock:
             self._params = params
             self._version += 1
+            # drop the previous generation's placements: entries for devices
+            # whose consumers have exited would otherwise pin a full placed
+            # param copy each, forever
+            self._placed.clear()
             return self._version
 
     def get(self) -> Tuple[int, Any]:

@@ -162,3 +162,20 @@ def test_param_store_placed_cache_shared_across_consumers():
     assert v2 == 2 and p2 is not p1
     import numpy as np
     np.testing.assert_array_equal(np.asarray(p2["w"]), 0.0)
+
+def test_param_store_placed_cache_dropped_on_publish():
+    """publish must drop the previous generation's placements — stale
+    per-device copies would otherwise be pinned forever after their
+    consumers exit (e.g. actor close in long-lived embedding processes)."""
+    import jax
+
+    from r2d2_tpu.utils.store import ParamStore
+
+    dev = jax.devices("cpu")[0]
+    store = ParamStore({"w": jax.numpy.ones((4,))})
+    store.get_placed(dev)
+    assert dev in store._placed
+    store.publish({"w": jax.numpy.zeros((4,))})
+    assert store._placed == {}  # old generation released immediately
+    store.get_placed(dev)
+    assert list(store._placed) == [dev]

@@ -221,3 +221,26 @@ def test_prefix_mass_full_layer_power_of_two_capacity():
     assert t.prefix_mass(127) == pytest.approx(t.total - 1.0, rel=1e-12)
     # the dp ready() pattern: last group's slab mass must be positive
     assert t.prefix_mass(128) - t.prefix_mass(64) == pytest.approx(64.0)
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_update_bounds_checked_both_backends(monkeypatch, force_numpy):
+    """Out-of-range leaf indices must raise IndexError identically on both
+    backends: the C loop would otherwise write outside the nodes heap and
+    the numpy path would silently overwrite ancestor sums via negative
+    indexing."""
+    from r2d2_tpu import native
+
+    if force_numpy:
+        monkeypatch.setenv("R2D2_NO_NATIVE", "1")
+        monkeypatch.setattr(native, "_tried", False)
+        monkeypatch.setattr(native, "_lib", None)
+    t = SumTree(64, prio_exponent=1.0, is_exponent=0.6,
+                rng=np.random.default_rng(0))
+    before = t.nodes.copy()
+    leaf_count = t.nodes.size - t.leaf_offset
+    for bad in ([-1], [leaf_count], [0, leaf_count + 5]):
+        with pytest.raises(IndexError):
+            t.update(np.asarray(bad), np.ones(len(bad)))
+    np.testing.assert_array_equal(t.nodes, before)  # nothing corrupted
+    with pytest.raises(IndexError):
+        t.prefix_mass(-3)

@@ -1,0 +1,44 @@
+"""Background tunnel-health probe loop.
+
+Probes the accelerator backend in a bounded subprocess (the bench.py
+probe) every ``interval`` seconds, appending one JSON line per attempt to
+the status file.  Exits as soon as a probe succeeds, so a watcher can
+``tail`` the file and launch the measurement battery the moment the chip
+answers.  Probes never hold a claim: a healthy child exits cleanly, a
+wedged child is killed while still stuck in backend init (it never
+acquired the chip).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from r2d2_tpu.bench import _device_probe  # noqa: E402
+
+STATUS = "/root/repo/tools/probe_status.jsonl"
+
+
+def main(interval: float = 600.0, probe_timeout: float = 180.0,
+         max_hours: float = 12.0) -> int:
+    deadline = time.time() + max_hours * 3600
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        t0 = time.time()
+        ok, reason = _device_probe(timeout_s=probe_timeout)
+        line = {"t": time.strftime("%H:%M:%S"), "attempt": attempt,
+                "ok": ok, "reason": reason,
+                "probe_secs": round(time.time() - t0, 1)}
+        with open(STATUS, "a") as f:
+            f.write(json.dumps(line) + "\n")
+        if ok:
+            return 0
+        time.sleep(max(0.0, interval - (time.time() - t0)))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(*(float(a) for a in sys.argv[1:])))
