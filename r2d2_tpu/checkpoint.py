@@ -70,6 +70,12 @@ class Checkpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def has_meta(self, step: int) -> bool:
+        """Whether ``step``'s metadata sidecar exists.  Process 0 writes it
+        after the orbax save, so its presence marks a finished save — the
+        live-follow evaluator gates on this."""
+        return os.path.exists(self._meta_path(step))
+
     def peek_meta(self, step: Optional[int] = None) -> Dict[str, Any]:
         """Read a checkpoint's metadata sidecar without touching the state
         (for pre-restore validation)."""

@@ -118,6 +118,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     pe.add_argument("--episodes", type=int, default=None)
     pe.add_argument("--out-json", default=None)
     pe.add_argument("--plot", default=None, help="write curve image here")
+    pe.add_argument("--follow", action="store_true",
+                    help="trail a concurrent training run: keep polling "
+                         "--ckpt-dir for new checkpoints (reference "
+                         "test.py:26-27 semantics)")
+    pe.add_argument("--follow-timeout", type=float, default=600.0,
+                    help="with --follow: exit after this many seconds "
+                         "without a new checkpoint (default 600)")
 
     pb = sub.add_parser("bench", help="single-chip learner throughput")
     pb.add_argument("--steps", type=int, default=100)
@@ -198,7 +205,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             env_factory=lambda c, seed: create_env(c, noop_start=True,
                                                    seed=seed),
             episodes=args.episodes, out_json=args.out_json,
-            out_plot=args.plot)
+            out_plot=args.plot, follow=args.follow,
+            follow_timeout=args.follow_timeout)
         for rec in curve:
             print(json.dumps(rec))
         return 0

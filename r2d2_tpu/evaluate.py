@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -91,19 +92,33 @@ def evaluate_sweep(cfg: Config,
                    episodes: Optional[int] = None,
                    out_json: Optional[str] = None,
                    out_plot: Optional[str] = None,
-                   action_dim: Optional[int] = None
+                   action_dim: Optional[int] = None,
+                   follow: bool = False,
+                   follow_timeout: Optional[float] = None,
+                   poll_interval: float = 2.0,
+                   stop: Optional[Callable[[], bool]] = None
                    ) -> List[Dict[str, float]]:
     """Walk every checkpoint in save order (test.py:26-40) and produce the
     learning curve: one record per checkpoint with training step, env
-    frames (env_steps × frameskip), wall-clock minutes, mean reward."""
+    frames (env_steps × frameskip), wall-clock minutes, mean reward.
+
+    With ``follow=True`` the sweep trails a concurrent training run the way
+    the reference evaluator does (test.py:26-27's poll-the-next-file walk):
+    after draining the checkpoints already on disk it keeps polling for new
+    ones, evaluating each as it appears, and exits when ``stop()`` reports
+    training finished (with one final drain) or when no new checkpoint has
+    appeared for ``follow_timeout`` seconds.  ``out_json`` is rewritten
+    after every record in follow mode so the curve file trails the run too.
+    A step is only picked up once its metadata sidecar exists — process 0
+    writes that after the orbax save, so its presence marks a finished save.
+    """
     ckpt = Checkpointer(checkpoint_dir)
     if action_dim is None:
         action_dim = env_factory(cfg, 0).action_space.n
     net = create_network(cfg, action_dim)
     act_fn = make_act_fn(cfg, net)
 
-    curve: List[Dict[str, float]] = []
-    for step in ckpt.steps():
+    def _eval_step(step: int) -> Dict[str, float]:
         from r2d2_tpu.checkpoint import check_arch_compat
 
         check_arch_compat(cfg, ckpt.peek_meta(step))
@@ -112,17 +127,49 @@ def evaluate_sweep(cfg: Config,
         mean_reward = evaluate_params(cfg, net, params, env_factory,
                                       episodes=episodes, seed=cfg.seed,
                                       act_fn=act_fn)
-        rec = dict(
+        return dict(
             step=step,
             env_frames=int(meta.get("env_steps", 0)) * cfg.frameskip,
             minutes=float(meta.get("minutes", 0.0)),
             mean_reward=mean_reward,
         )
-        curve.append(rec)
 
-    if out_json:
-        with open(out_json, "w") as f:
-            json.dump(curve, f, indent=2)
+    def _write(curve: List[Dict[str, float]]) -> None:
+        if out_json:
+            # atomic replace: follow mode invites concurrent readers, who
+            # must never observe a truncated file mid-rewrite
+            tmp = f"{out_json}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(curve, f, indent=2)
+            os.replace(tmp, out_json)
+
+    curve: List[Dict[str, float]] = []
+    seen: set = set()
+    last_new = time.monotonic()
+    while True:
+        stopping = stop() if (follow and stop is not None) else False
+        fresh = [s for s in ckpt.steps() if s not in seen]
+        if follow:
+            # gate on the sidecar: a step dir may be visible mid-save
+            fresh = [s for s in fresh if ckpt.has_meta(s)]
+        for step in fresh:
+            seen.add(step)
+            curve.append(_eval_step(step))
+            if follow:
+                _write(curve)
+        if fresh:
+            last_new = time.monotonic()
+        if not follow:
+            break
+        if stopping and not fresh:
+            break  # training done and the final drain found nothing new
+        if (follow_timeout is not None and not fresh
+                and time.monotonic() - last_new > follow_timeout):
+            break
+        if not fresh:
+            time.sleep(poll_interval)
+
+    _write(curve)
     if out_plot:
         _plot_curve(cfg, curve, out_plot)
     return curve

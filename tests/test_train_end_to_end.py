@@ -271,3 +271,53 @@ def test_train_threaded_fabric_multi_fleet():
     assert metrics["num_updates"] >= cfg.training_steps
     assert np.isfinite(metrics["mean_loss"])
     assert not metrics["fabric_failed"]
+
+def test_evaluate_sweep_follow_trails_training(tmp_path):
+    """--follow mode (reference test.py:26-27): the sweep starts before any
+    checkpoint exists, picks each one up as the concurrent training run
+    saves it, and exits after a final drain once training reports done."""
+    import json
+    import threading
+
+    from r2d2_tpu.checkpoint import Checkpointer
+
+    ck_dir = os.path.join(tmp_path, "ck")
+    cfg = make_test_config(game_name="Fake", training_steps=20,
+                           save_interval=10)
+    assert Checkpointer(ck_dir).steps() == []  # nothing on disk at start
+
+    done = threading.Event()
+
+    def run_train():
+        try:
+            train_sync(cfg, env_factory=env_factory, checkpoint_dir=ck_dir)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run_train, daemon=True)
+    t.start()
+    out_json = os.path.join(tmp_path, "curve.json")
+    curve = evaluate_sweep(cfg, ck_dir, env_factory, episodes=2,
+                           action_dim=A, out_json=out_json,
+                           follow=True, poll_interval=0.1,
+                           stop=done.is_set, follow_timeout=120.0)
+    t.join(timeout=60)
+
+    # every checkpoint the run saved was evaluated, in save order
+    assert [c["step"] for c in curve] == Checkpointer(ck_dir).steps()
+    assert len(curve) >= 2
+    with open(out_json) as f:
+        assert json.load(f) == curve  # trailing writes end consistent
+
+
+def test_evaluate_sweep_follow_timeout_exits(tmp_path):
+    """With no stop signal and no new checkpoints, --follow exits after
+    follow_timeout instead of polling forever."""
+    ck_dir = os.path.join(tmp_path, "ck")
+    cfg = make_test_config(game_name="Fake", training_steps=10,
+                           save_interval=10)
+    train_sync(cfg, env_factory=env_factory, checkpoint_dir=ck_dir)
+    curve = evaluate_sweep(cfg, ck_dir, env_factory, episodes=2,
+                           action_dim=A, follow=True, poll_interval=0.1,
+                           follow_timeout=0.5)
+    assert len(curve) >= 1
