@@ -1,0 +1,28 @@
+import jax; jax.config.update("jax_platforms", "cpu")
+import sys, cProfile, pstats, io
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from r2d2_tpu.actor import VectorActor, make_act_fn
+from r2d2_tpu.config import pong_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.utils.math import epsilon_ladder
+from r2d2_tpu.utils.store import ParamStore
+
+cfg = pong_config(game_name="Fake", num_actors=64)
+net = create_network(cfg, 4)
+params = init_params(cfg, net, jax.random.PRNGKey(0))
+store = ParamStore(params)
+act_fn = make_act_fn(cfg, net)
+envs = [FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=4, seed=i, episode_len=500) for i in range(64)]
+eps = [epsilon_ladder(i, 64) for i in range(64)]
+actor = VectorActor(cfg, envs, eps, act_fn, store, sink=lambda b,p,r: None, rng=np.random.default_rng(1))
+actor.run(max_steps=20)  # warmup
+
+pr = cProfile.Profile()
+pr.enable()
+actor.run(max_steps=200)
+pr.disable()
+s = io.StringIO()
+pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(22)
+print("\n".join(s.getvalue().splitlines()[:40]))

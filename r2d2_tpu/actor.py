@@ -40,7 +40,7 @@ import numpy as np
 
 from r2d2_tpu.config import Config
 from r2d2_tpu.models.network import R2D2Network
-from r2d2_tpu.replay.block import Block, LocalBuffer
+from r2d2_tpu.replay.block import Block, VectorLocalBuffer
 from r2d2_tpu.utils.store import ParamStore
 
 # sink(block, priorities, episode_reward_or_None) — direct buffer.add in the
@@ -174,7 +174,9 @@ class VectorActor:
             self._pool = ThreadPoolExecutor(max_workers=len(self._shards),
                                             thread_name_prefix="env")
         self.action_dim = envs[0].action_space.n
-        self.buffers = [LocalBuffer(cfg, self.action_dim) for _ in envs]
+        # one preallocated array set for all lanes: per-step recording is a
+        # few vectorized writes instead of N×(list appends + array builds)
+        self.vbuf = VectorLocalBuffer(cfg, self.action_dim, self.N)
         self.episode_steps = np.zeros(self.N, np.int64)
         self.finish_pending = np.zeros(self.N, bool)  # deferred boundary cut
         self.actor_steps = 0
@@ -187,6 +189,10 @@ class VectorActor:
         self.last_reward = np.zeros(self.N, np.float32)
         self.hidden = np.zeros((self.N, 2, cfg.lstm_layers, cfg.hidden_dim),
                                np.float32)
+        # per-iteration env-step scratch, filled by the (possibly pooled)
+        # env stepping and consumed by the vectorized batched update
+        self._step_reward = np.zeros(self.N, np.float32)
+        self._step_done = np.zeros(self.N, bool)
         for i in range(self.N):
             self._reset_lane(i)
 
@@ -196,7 +202,7 @@ class VectorActor:
         self.last_action[i] = 0.0
         self.last_reward[i] = 0.0
         self.hidden[i] = 0.0
-        self.buffers[i].reset(self.obs[i])
+        self.vbuf.reset_lane(i, self.obs[i])
         self.episode_steps[i] = 0
         self.finish_pending[i] = False
 
@@ -226,36 +232,17 @@ class VectorActor:
             self._params = params
             self._param_version = version
 
-    def _step_lane(self, i: int, a: int, q_i: np.ndarray,
-                   new_hidden_i: np.ndarray) -> bool:
-        """Advance one lane by one env step (reference actor body,
-        worker.py:537-554).  Returns True when the lane hit the
-        episode-step cap and needs the batched bootstrap pass."""
-        cfg = self.cfg
-        obs, reward, terminated, truncated, _ = self.envs[i].step(a)
-        done = bool(terminated or truncated)
-        self.obs[i] = np.asarray(obs, np.uint8)
-        self.last_action[i] = 0.0
-        self.last_action[i, a] = 1.0
-        self.last_reward[i] = reward
-        self.hidden[i] = new_hidden_i
-        self.episode_steps[i] += 1
-
-        self.buffers[i].add(a, float(reward), self.obs[i], q_i, new_hidden_i)
-
-        if done:
-            self.sink(*self.buffers[i].finish(None))
-            self._reset_lane(i)
-        elif self.episode_steps[i] >= cfg.max_episode_steps:
-            return True
-        elif len(self.buffers[i]) == cfg.block_length:
-            self.finish_pending[i] = True
-        return False
-
-    def _step_shard(self, lanes: range, actions: np.ndarray, q: np.ndarray,
-                    new_hidden: np.ndarray) -> List[int]:
-        return [i for i in lanes
-                if self._step_lane(i, int(actions[i]), q[i], new_hidden[i])]
+    def _step_shard(self, lanes: range, actions: np.ndarray) -> None:
+        """Env-step a contiguous lane shard (the only per-lane Python left
+        in the hot loop — the gym API is per-env; ALE releases the GIL in
+        ``step`` so shards scale across the thread pool).  Results land in
+        the batched scratch arrays; all bookkeeping is vectorized later."""
+        for i in lanes:
+            obs, reward, terminated, truncated, _ = self.envs[i].step(
+                int(actions[i]))
+            self.obs[i] = np.asarray(obs, np.uint8)
+            self._step_reward[i] = reward
+            self._step_done[i] = terminated or truncated
 
     def close(self) -> None:
         """Shut down the env-worker pool (no-op for serial actors).  The
@@ -286,7 +273,7 @@ class VectorActor:
             # state is the bootstrap value (worker.py:550-554 semantics,
             # without the second forward)
             for i in np.nonzero(self.finish_pending)[0]:
-                self.sink(*self.buffers[i].finish(q[i]))
+                self.sink(*self.vbuf.finish(i, q[i]))
                 self.finish_pending[i] = False
 
             explore = self.rng.random(self.N) < self.epsilons
@@ -294,18 +281,41 @@ class VectorActor:
                                self.rng.integers(self.action_dim, size=self.N),
                                q.argmax(axis=1)).astype(np.int64)
 
+            # env stepping: per-lane (gym API), possibly pooled
             if self._pool is None:
-                capped = self._step_shard(self._shards[0], actions, q,
-                                          new_hidden)
+                self._step_shard(self._shards[0], actions)
             else:
-                futures = [self._pool.submit(self._step_shard, shard,
-                                             actions, q, new_hidden)
+                futures = [self._pool.submit(self._step_shard, shard, actions)
                            for shard in self._shards]
-                # sorted: shard completion order is nondeterministic, but
-                # the capped bootstrap pass below should not be
-                capped = sorted(i for f in futures for i in f.result())
+                for f in futures:
+                    f.result()
 
-            if capped:
+            # all per-step bookkeeping, vectorized over the whole fleet
+            # (reference actor body worker.py:537-554, batched)
+            lanes = np.arange(self.N)
+            self.last_action[:] = 0.0
+            self.last_action[lanes, actions] = 1.0
+            self.last_reward[:] = self._step_reward
+            np.copyto(self.hidden, new_hidden)
+            self.episode_steps += 1
+            self.vbuf.add_batch(lanes, actions, self._step_reward, self.obs,
+                                q, new_hidden)
+
+            done_lanes = np.nonzero(self._step_done)[0]
+            for i in done_lanes:
+                self.sink(*self.vbuf.finish(i, None))
+                self._reset_lane(i)
+
+            capped = np.nonzero(~self._step_done
+                                & (self.episode_steps >= cfg.max_episode_steps)
+                                )[0]
+            boundary = ~self._step_done & (self.vbuf.sizes()
+                                           == cfg.block_length)
+            self.finish_pending |= boundary & (self.episode_steps
+                                               < cfg.max_episode_steps)
+            self._step_done[:] = False
+
+            if capped.size:
                 # episode-step cap (rare): the bootstrap must be Q at the
                 # post-step state (worker.py:550-554 runs a second forward);
                 # one extra batched forward covers all capped lanes
@@ -314,7 +324,7 @@ class VectorActor:
                                          self.hidden)
                 q_fresh = np.asarray(q_fresh)
                 for i in capped:
-                    self.sink(*self.buffers[i].finish(q_fresh[i]))
+                    self.sink(*self.vbuf.finish(i, q_fresh[i]))
                     self._reset_lane(i)
 
             self.actor_steps += 1

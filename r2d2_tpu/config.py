@@ -50,6 +50,15 @@ class Config:
     burn_in_steps: int = 40     # reference: config.py:27
     learning_steps: int = 40    # reference: config.py:28
     forward_steps: int = 5      # reference: config.py:29 (n-step bootstrap)
+    stored_hidden_mode: str = "burn_in_start"
+    # Which recurrent state a sequence stores for replay:
+    #   "burn_in_start" — state at the sequence's burn-in start (the R2D2
+    #       paper's scheme; replay/block.py docstring).
+    #   "seq_start"     — the reference's indexing (worker.py:461,
+    #       hidden_buffer[i * learning_steps]): identical once an episode's
+    #       carried prefix is full, but for the first block of an episode it
+    #       feeds a state recorded after part of the burn-in window.
+    # Compat switch so the divergence can be A/B'd (tools/ab_curves.py).
 
     # --- actor fleet ------------------------------------------------------
     num_actors: int = 8         # reference: config.py:21
@@ -188,6 +197,9 @@ class Config:
         if self.lstm_impl not in ("auto", "scan", "pallas",
                           "pallas_spmd"):
             raise ValueError(f"unknown lstm_impl {self.lstm_impl!r}")
+        if self.stored_hidden_mode not in ("burn_in_start", "seq_start"):
+            raise ValueError(
+                f"unknown stored_hidden_mode {self.stored_hidden_mode!r}")
         if self.obs_space_to_depth:
             h, w, _ = self.obs_shape
             if h % 4 or w % 4:

@@ -13,7 +13,10 @@ at each sequence's **burn-in start** (the R2D2 paper's scheme).  The
 reference samples them at ``i * learning_steps`` into the buffer
 (worker.py:461), which for blocks whose carried burn-in prefix is shorter
 than ``burn_in_steps`` (i.e. the first block of every episode) feeds a state
-recorded *after* the burn-in window it is unrolled over.
+recorded *after* the burn-in window it is unrolled over.  The reference's
+indexing is available as a compat switch
+(``Config.stored_hidden_mode="seq_start"``) so the divergence can be A/B'd;
+the two schemes coincide whenever the carried prefix is full.
 """
 from __future__ import annotations
 
@@ -54,6 +57,65 @@ class Block:
     burn_in_steps: np.ndarray
     learning_steps: np.ndarray
     forward_steps: np.ndarray
+
+
+def assemble_block(cfg: Config, *, obs: np.ndarray, last_action: np.ndarray,
+                   last_reward: np.ndarray, hidden_stream: np.ndarray,
+                   actions: np.ndarray, rewards: np.ndarray,
+                   qvals: np.ndarray, prefix: int, size: int, done: bool
+                   ) -> Tuple[Block, np.ndarray]:
+    """The block math shared by :class:`LocalBuffer` (list-backed) and
+    :class:`VectorLocalBuffer` (preallocated-array-backed): per-sequence
+    window sizes (worker.py:471-474), stored-hidden selection, n-step
+    targets, and the actor-side initial priorities (worker.py:477-483 —
+    plain max-Q n-step TD, no value rescale / double-Q, replicating the
+    reference's asymmetry vs the learner).
+
+    ``obs``/``last_action``/``last_reward``/``hidden_stream`` are the full
+    (prefix + size + 1)-entry streams; ``qvals`` is (size+1, A) with the
+    bootstrap value (zeros when ``done``) in the last row.  The arrays are
+    stored in the Block as-is — callers reusing backing storage must pass
+    copies.
+    """
+    L, n = cfg.learning_steps, cfg.forward_steps
+    c = prefix
+    num_sequences = math.ceil(size / L)
+
+    gamma_tail = n_step_gamma_tail(size, n, cfg.gamma, done)
+    nstep_r = n_step_return(np.asarray(rewards, np.float32), n, cfg.gamma)
+
+    # per-sequence window sizes (worker.py:471-474 invariants)
+    seq_ids = np.arange(num_sequences)
+    burn_in = np.minimum(seq_ids * L + c, cfg.burn_in_steps).astype(np.uint8)
+    learning = np.minimum(L, size - seq_ids * L).astype(np.uint8)
+    forward = np.minimum(n, size + 1 - np.cumsum(learning)).astype(np.uint8)
+    assert forward[-1] == 1 and burn_in[0] == min(c, cfg.burn_in_steps)
+
+    # recurrent state at each sequence's burn-in start (paper-correct; see
+    # module docstring for the divergence from worker.py:461), or the
+    # reference's own indexing under stored_hidden_mode="seq_start"
+    if cfg.stored_hidden_mode == "seq_start":
+        hidden_idx = seq_ids * L
+    else:
+        hidden_idx = c + seq_ids * L - burn_in.astype(np.int64)
+    hiddens = np.asarray(hidden_stream[hidden_idx], np.float32)
+
+    max_forward = min(size, n)
+    max_q = qvals[max_forward:size + 1].max(axis=1)
+    max_q = np.pad(max_q, (0, max_forward - 1), mode="edge")
+    taken_q = qvals[np.arange(size), actions]
+    td = np.abs(nstep_r + gamma_tail * max_q - taken_q).astype(np.float32)
+    priorities = np.zeros(cfg.seqs_per_block, np.float32)
+    priorities[:num_sequences] = mixed_td_errors(td, learning)
+
+    block = Block(
+        obs=obs, last_action=last_action, last_reward=last_reward,
+        action=actions, n_step_reward=nstep_r, n_step_gamma=gamma_tail,
+        hidden=hiddens, num_sequences=num_sequences,
+        burn_in_steps=burn_in, learning_steps=learning,
+        forward_steps=forward,
+    )
+    return block, priorities
 
 
 class LocalBuffer:
@@ -120,9 +182,8 @@ class LocalBuffer:
         """
         cfg = self.cfg
         assert 0 < self.size <= cfg.block_length
-        size, L, n = self.size, cfg.learning_steps, cfg.forward_steps
+        size = self.size
         c = self.curr_burn_in_steps
-        num_sequences = math.ceil(size / L)
         self.done = last_qval is None
 
         qvals = list(self.qval_buffer)
@@ -132,43 +193,15 @@ class LocalBuffer:
             qvals.append(np.asarray(last_qval, np.float32).reshape(self.action_dim))
         qvals = np.stack(qvals)                       # (size+1, A)
 
-        gamma_tail = n_step_gamma_tail(size, n, cfg.gamma, self.done)
-        nstep_r = n_step_return(np.asarray(self.reward_buffer, np.float32), n, cfg.gamma)
-
-        obs = np.stack(self.obs_buffer)
-        last_action = np.stack(self.last_action_buffer)
-        last_reward = np.asarray(self.last_reward_buffer, np.float32)
-        actions = np.asarray(self.action_buffer, np.uint8)
-
-        # per-sequence window sizes (worker.py:471-474 invariants)
-        seq_ids = np.arange(num_sequences)
-        burn_in = np.minimum(seq_ids * L + c, cfg.burn_in_steps).astype(np.uint8)
-        learning = np.minimum(L, size - seq_ids * L).astype(np.uint8)
-        forward = np.minimum(n, size + 1 - np.cumsum(learning)).astype(np.uint8)
-        assert forward[-1] == 1 and burn_in[0] == min(c, cfg.burn_in_steps)
-
-        # recurrent state at each sequence's burn-in start (paper-correct; see
-        # module docstring for the divergence from worker.py:461)
-        hidden_idx = c + seq_ids * L - burn_in.astype(np.int64)
-        hiddens = np.stack([self.hidden_buffer[i] for i in hidden_idx])
-
-        # actor-side initial priorities: plain max-Q n-step TD, no value
-        # rescale and no double-Q — replicating the reference's asymmetry
-        # vs the learner (worker.py:477-483)
-        max_forward = min(size, n)
-        max_q = qvals[max_forward:size + 1].max(axis=1)
-        max_q = np.pad(max_q, (0, max_forward - 1), mode="edge")
-        taken_q = qvals[np.arange(size), actions]
-        td = np.abs(nstep_r + gamma_tail * max_q - taken_q).astype(np.float32)
-        priorities = np.zeros(cfg.seqs_per_block, np.float32)
-        priorities[:num_sequences] = mixed_td_errors(td, learning)
-
-        block = Block(
-            obs=obs, last_action=last_action, last_reward=last_reward,
-            action=actions, n_step_reward=nstep_r, n_step_gamma=gamma_tail,
-            hidden=hiddens.astype(np.float32), num_sequences=num_sequences,
-            burn_in_steps=burn_in, learning_steps=learning, forward_steps=forward,
-        )
+        block, priorities = assemble_block(
+            cfg,
+            obs=np.stack(self.obs_buffer),
+            last_action=np.stack(self.last_action_buffer),
+            last_reward=np.asarray(self.last_reward_buffer, np.float32),
+            hidden_stream=np.stack(self.hidden_buffer),
+            actions=np.asarray(self.action_buffer, np.uint8),
+            rewards=np.asarray(self.reward_buffer, np.float32),
+            qvals=qvals, prefix=c, size=size, done=self.done)
         episode_reward = self.sum_reward if self.done else None
 
         # carry the burn-in prefix into the next block (worker.py:486-493)
@@ -182,5 +215,119 @@ class LocalBuffer:
         self.qval_buffer.clear()
         self.curr_burn_in_steps = len(self.obs_buffer) - 1
         self.size = 0
+
+        return block, priorities, episode_reward
+
+
+class VectorLocalBuffer:
+    """Batched LocalBuffer: one preallocated array set shared by N lanes.
+
+    The per-env-step host cost of N :class:`LocalBuffer`\\ s (5 list appends
+    + 2 small array builds per lane per step — the reference's per-actor
+    hot loop, worker.py:426-435) becomes a handful of vectorized
+    fancy-indexed writes per *batched* step, one numpy op per field for
+    ALL lanes at once.  Blocks and priorities are bit-identical to the
+    list-backed implementation (shared :func:`assemble_block`; oracle test
+    in tests/test_local_buffer.py).
+
+    Lifecycle per lane mirrors LocalBuffer: ``reset_lane`` at episode
+    start, one ``add_batch`` row per env step, ``finish(i)`` at episode
+    end / block boundary / step cap (the trailing ``burn_in_steps + 1``
+    stream entries are retained in place as the next block's warm
+    prefix).
+    """
+
+    def __init__(self, cfg: Config, action_dim: int, num_lanes: int):
+        self.cfg = cfg
+        self.action_dim = action_dim
+        N, B = num_lanes, cfg.block_length
+        cap = cfg.burn_in_steps + B + 1  # obs-stream entries per block max
+        self.cap = cap
+        self.obs = np.zeros((N, cap, *cfg.stored_obs_shape), np.uint8)
+        self.last_action = np.zeros((N, cap, action_dim), bool)
+        self.last_reward = np.zeros((N, cap), np.float32)
+        self.hidden = np.zeros(
+            (N, cap, 2, cfg.lstm_layers, cfg.hidden_dim), np.float32)
+        self.action = np.zeros((N, B), np.uint8)
+        self.reward = np.zeros((N, B), np.float32)
+        self.qval = np.zeros((N, B + 1, action_dim), np.float32)
+        self.prefix = np.zeros(N, np.int64)      # carried burn-in length c
+        self.size = np.zeros(N, np.int64)        # env steps in current block
+        self.sum_reward = np.zeros(N, np.float64)
+
+    def sizes(self) -> np.ndarray:
+        """Per-lane current block sizes (read-only view)."""
+        return self.size
+
+    def reset_lane(self, i: int, init_obs: np.ndarray) -> None:
+        self.obs[i, 0] = np.asarray(init_obs, np.uint8)
+        self.last_action[i, 0] = False
+        self.last_action[i, 0, 0] = True  # noop one-hot
+        self.last_reward[i, 0] = 0.0
+        self.hidden[i, 0] = 0.0
+        self.prefix[i] = 0
+        self.size[i] = 0
+        self.sum_reward[i] = 0.0
+
+    def add_batch(self, idx: np.ndarray, actions: np.ndarray,
+                  rewards: np.ndarray, next_obs: np.ndarray,
+                  q: np.ndarray, hidden: np.ndarray) -> None:
+        """Record one env step for every lane in ``idx``.
+
+        ``next_obs``/``q``/``hidden`` are the full (N, ...) batched arrays
+        (rows outside ``idx`` ignored); ``hidden`` rows are the state
+        *after* consuming the obs that produced ``q`` (same alignment as
+        LocalBuffer.add).
+        """
+        p = self.prefix[idx] + self.size[idx] + 1  # append position
+        self.obs[idx, p] = next_obs[idx]
+        self.last_action[idx, p] = False
+        self.last_action[idx, p, actions[idx]] = True
+        self.last_reward[idx, p] = rewards[idx]
+        self.hidden[idx, p] = hidden[idx]
+        s = self.size[idx]
+        self.action[idx, s] = actions[idx]
+        self.reward[idx, s] = rewards[idx]
+        self.qval[idx, s] = q[idx]
+        self.sum_reward[idx] += rewards[idx]
+        self.size[idx] += 1
+
+    def finish(self, i: int, last_qval: Optional[np.ndarray] = None
+               ) -> Tuple[Block, np.ndarray, Optional[float]]:
+        """Close lane ``i``'s current chunk into a Block (LocalBuffer.finish
+        semantics: ``last_qval=None`` = terminated; returns
+        ``(block, priorities, episode_reward or None)``)."""
+        cfg = self.cfg
+        size, c = int(self.size[i]), int(self.prefix[i])
+        assert 0 < size <= cfg.block_length
+        done = last_qval is None
+        entries = c + size + 1
+
+        qvals = self.qval[i, :size + 1].copy()
+        qvals[size] = (np.zeros(self.action_dim, np.float32) if done
+                       else np.asarray(last_qval, np.float32
+                                       ).reshape(self.action_dim))
+
+        block, priorities = assemble_block(
+            cfg,
+            # copies: the Block must not alias storage the next block reuses
+            obs=self.obs[i, :entries].copy(),
+            last_action=self.last_action[i, :entries].copy(),
+            last_reward=self.last_reward[i, :entries].copy(),
+            hidden_stream=self.hidden[i, :entries],  # fancy-indexed → copies
+            actions=self.action[i, :size].copy(),
+            rewards=self.reward[i, :size],
+            qvals=qvals, prefix=c, size=size, done=done)
+        episode_reward = float(self.sum_reward[i]) if done else None
+
+        # retain the trailing burn_in+1 stream entries as the next block's
+        # warm prefix (worker.py:486-493), in place
+        keep = min(cfg.burn_in_steps + 1, entries)
+        lo = entries - keep
+        for arr in (self.obs, self.last_action, self.last_reward,
+                    self.hidden):
+            arr[i, :keep] = arr[i, lo:entries].copy()  # overlap-safe
+        self.prefix[i] = keep - 1
+        self.size[i] = 0
 
         return block, priorities, episode_reward

@@ -117,3 +117,105 @@ def test_n_step_reward_alignment():
     for t in range(8):
         expected = sum(g ** i * rewards[t + i] for i in range(n) if t + i < 8)
         np.testing.assert_allclose(block.n_step_reward[t], expected, rtol=1e-5)
+
+def test_stored_hidden_mode_seq_start_matches_reference_indexing():
+    """stored_hidden_mode="seq_start" reproduces the reference's
+    worker.py:461 scheme (hidden_buffer[i * learning_steps]): divergent
+    from the paper scheme on an episode's first block, identical once the
+    carried prefix is full."""
+    cfg = CFG.replace(stored_hidden_mode="seq_start")
+    rng = np.random.default_rng(7)
+    lb = LocalBuffer(cfg, A)
+    lb.reset(rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8))
+    hiddens_fed = [np.zeros((2, cfg.lstm_layers, cfg.hidden_dim),
+                            np.float32)]
+    for _ in range(cfg.block_length):
+        obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+        h = rng.normal(size=(2, cfg.lstm_layers, cfg.hidden_dim)
+                       ).astype(np.float32)
+        lb.add(0, 0.0, obs, np.zeros(A, np.float32), h)
+        hiddens_fed.append(h)
+    block, _, _ = lb.finish(last_qval=np.zeros(A, np.float32))
+    # first block, seq 1: reference feeds the state at i*L = step 4 —
+    # recorded AFTER its burn-in window [0, 4) — not the paper's step 0
+    np.testing.assert_array_equal(block.hidden[0], hiddens_fed[0])
+    np.testing.assert_array_equal(block.hidden[1], hiddens_fed[4])
+
+    # second block (full prefix, c = burn_in): schemes coincide
+    prefix_state = lb.hidden_buffer[0]
+    for _ in range(cfg.block_length):
+        obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+        h = rng.normal(size=(2, cfg.lstm_layers, cfg.hidden_dim)
+                       ).astype(np.float32)
+        lb.add(0, 0.0, obs, np.zeros(A, np.float32), h)
+    block2, _, _ = lb.finish(last_qval=np.zeros(A, np.float32))
+    np.testing.assert_array_equal(block2.hidden[0], prefix_state)
+
+def _assert_blocks_equal(b1, b2):
+    import dataclasses as dc
+    for f in dc.fields(b1):
+        v1, v2 = getattr(b1, f.name), getattr(b2, f.name)
+        if isinstance(v1, np.ndarray):
+            np.testing.assert_array_equal(v1, v2, err_msg=f.name)
+            assert v1.dtype == v2.dtype, f.name
+        else:
+            assert v1 == v2, f.name
+
+
+@pytest.mark.parametrize("mode", ["burn_in_start", "seq_start"])
+def test_vector_local_buffer_matches_list_oracle(mode):
+    """VectorLocalBuffer must be bit-identical to LocalBuffer over a
+    multi-lane trajectory with terminals, block boundaries, and partial
+    final chunks (shared assemble_block + identical carryover)."""
+    from r2d2_tpu.replay.block import VectorLocalBuffer
+
+    cfg = CFG.replace(stored_hidden_mode=mode)
+    rng = np.random.default_rng(9)
+    N = 3
+    refs = [LocalBuffer(cfg, A) for _ in range(N)]
+    vec = VectorLocalBuffer(cfg, A, N)
+    init = [rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+            for _ in range(N)]
+    for i in range(N):
+        refs[i].reset(init[i])
+        vec.reset_lane(i, init[i])
+
+    # scripted per-step batch inputs; lanes finish at staggered points
+    finish_at = {0: [(8, "boundary"), (14, "terminal")],
+                 1: [(5, "terminal"), (13, "boundary")],
+                 2: [(8, "boundary"), (16, "boundary")]}
+    steps = {i: 0 for i in range(N)}
+    for t in range(16):
+        actions = rng.integers(A, size=N)
+        rewards = rng.normal(size=N).astype(np.float32)
+        next_obs = rng.integers(0, 255, (N, *cfg.obs_shape), dtype=np.uint8)
+        q = rng.normal(size=(N, A)).astype(np.float32)
+        hid = rng.normal(size=(N, 2, cfg.lstm_layers, cfg.hidden_dim)
+                         ).astype(np.float32)
+        active = np.arange(N)
+        for i in range(N):
+            refs[i].add(int(actions[i]), float(rewards[i]), next_obs[i],
+                        q[i], hid[i])
+        vec.add_batch(active, actions, rewards, next_obs, q, hid)
+        for i in range(N):
+            steps[i] += 1
+            for (at, kind) in finish_at[i]:
+                if steps[i] == at:
+                    last_q = (None if kind == "terminal"
+                              else rng.normal(size=A).astype(np.float32))
+                    b_ref, p_ref, r_ref = refs[i].finish(last_q)
+                    b_vec, p_vec, r_vec = vec.finish(i, last_q)
+                    _assert_blocks_equal(b_ref, b_vec)
+                    np.testing.assert_array_equal(p_ref, p_vec)
+                    assert (r_ref is None) == (r_vec is None)
+                    if r_ref is not None:
+                        assert r_ref == pytest.approx(r_vec)
+                    if kind == "terminal":
+                        o = rng.integers(0, 255, cfg.obs_shape,
+                                         dtype=np.uint8)
+                        refs[i].reset(o)
+                        vec.reset_lane(i, o)
+                        steps[i] = 0
+                    # carryover state must also agree for the NEXT block
+                    assert refs[i].curr_burn_in_steps == vec.prefix[i]
+                    assert len(refs[i]) == vec.size[i]
