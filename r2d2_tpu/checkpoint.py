@@ -54,8 +54,14 @@ class Checkpointer:
         import jax
 
         if jax.process_index() == 0:
-            with open(self._meta_path(step), "w") as f:
+            # atomic: the follow-mode evaluator gates on this file's
+            # existence and reads it immediately — it must never observe
+            # a partially written sidecar
+            meta_path = self._meta_path(step)
+            tmp = f"{meta_path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
                 json.dump(dict(meta or {}, step=step), f)
+            os.replace(tmp, meta_path)
 
     def steps(self) -> list:
         """All checkpointed steps, ascending."""
