@@ -29,6 +29,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -113,8 +114,19 @@ def _learner_micro_bench(steps: int, warmup: int):
     return frames_per_sec, steps_per_sec, flops
 
 
-def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64):
-    """env-frames/s of a pong-scale lockstep fleet on fake envs."""
+def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64,
+                       env_workers: Optional[int] = None,
+                       act_device: Optional[str] = None,
+                       fleets: int = 1):
+    """env-frames/s of a pong-scale lockstep fleet on fake envs.
+
+    ``env_workers``/``act_device``/``fleets`` override the preset so
+    tools/actor_scaling.py and the measurement battery can sweep the
+    env-stepping pool width, CPU-twin vs on-device acting, and the number
+    of independent lockstep fleets (lanes split contiguously, each fleet
+    its own thread — exactly train.py's actor_fleets split)."""
+    import threading
+
     import jax
 
     from r2d2_tpu.actor import VectorActor, make_act_fn
@@ -124,28 +136,46 @@ def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64):
     from r2d2_tpu.utils.math import epsilon_ladder
     from r2d2_tpu.utils.store import ParamStore
 
-    cfg = pong_config(game_name="Fake", num_actors=num_lanes)
+    over = {}
+    if env_workers is not None:
+        over["env_workers"] = env_workers
+    if act_device is not None:
+        over["act_device"] = act_device
+    cfg = pong_config(game_name="Fake", num_actors=num_lanes, **over)
     net = create_network(cfg, 4)
     params = init_params(cfg, net, jax.random.PRNGKey(0))
     store = ParamStore(params)
     act_fn = make_act_fn(cfg, net)
-    envs = [FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=4,
-                         seed=i, episode_len=500) for i in range(num_lanes)]
-    eps = [epsilon_ladder(i, num_lanes) for i in range(num_lanes)]
     sunk = []
-    actor = VectorActor(cfg, envs, eps, act_fn, store,
-                        sink=lambda b, p, r: sunk.append(1),
-                        rng=np.random.default_rng(1))
-    actor.run(max_steps=20)  # warmup: compile act fn, prime pools
+    per = num_lanes // fleets
+    actors = []
+    for f in range(fleets):
+        lanes = range(f * per, (f + 1) * per)
+        envs = [FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=4,
+                             seed=i, episode_len=500) for i in lanes]
+        eps = [epsilon_ladder(i, num_lanes) for i in lanes]
+        actors.append(VectorActor(cfg, envs, eps, act_fn, store,
+                                  sink=lambda b, p, r: sunk.append(1),
+                                  rng=np.random.default_rng(1 + f)))
+    for a in actors:
+        a.run(max_steps=20)  # warmup: compile act fn, prime pools
+    threads = [threading.Thread(target=a.run,
+                                kwargs=dict(max_steps=iterations))
+               for a in actors[1:]]
     t0 = time.perf_counter()
-    actor.run(max_steps=iterations)
+    for t in threads:
+        t.start()
+    actors[0].run(max_steps=iterations)
+    for t in threads:
+        t.join()
     dt = time.perf_counter() - t0
-    actor.close()
-    return num_lanes * iterations / dt
+    for a in actors:
+        a.close()
+    return fleets * per * iterations / dt
 
 
 def _system_bench(wall_seconds: float, *, device_replay: bool = True,
-                  superstep_k: int = 16, num_actors: int = 64,
+                  superstep_k: int = 4, num_actors: int = 64,
                   env_workers: int = 0, superstep_pipeline: int = 2):
     """Steady-state env-frames/s of the full threaded fabric on fake envs.
 
@@ -166,9 +196,12 @@ def _system_bench(wall_seconds: float, *, device_replay: bool = True,
         save_interval=1_000_000_000,
         device_replay=device_replay,  # HBM-resident ring + in-graph gather
         superstep_k=superstep_k,      # optimizer steps per dispatch — the
-                                      # pong/hard-exploration presets' value,
-                                      # so the system number measures what
-                                      # the learning configs actually run
+                                      # pong/hard-exploration presets' value
+                                      # (k=4 since the CURVES_AB_PIPELINE_r04
+                                      # lag A/B), so the system number
+                                      # measures what the learning configs
+                                      # actually run; tools/tune_system.py
+                                      # sweeps the grid for the ceiling
         superstep_pipeline=superstep_pipeline,  # in-flight dispatches:
                                       # result copies start at enqueue, so
                                       # >=2 keeps the device busy while

@@ -239,18 +239,26 @@ def smoke_config(**kw) -> Config:
 
 
 def pong_config(**kw) -> Config:
-    """configs[1]: Pong, 64 actors."""
+    """configs[1]: Pong, 64 actors.
+
+    superstep_k=4: the priority-feedback lag is ≤ (pipeline+1)·k = 12
+    updates — the reference's own lag envelope (8-batch queue + 4-batch
+    staging, worker.py:300-316).  k=16 (lag 48) showed a measurable
+    late-curve tax in the 3-run fabric A/B (CURVES_AB_PIPELINE_r04*:
+    late-mean 20.4 vs 25.6 baseline, k=4 at parity 25.1); k=16 remains a
+    throughput-bench knob, not a learning default."""
     base = dict(game_name="Pong", num_actors=64, env_workers=8,
-                device_replay=True, superstep_k=16, superstep_pipeline=2)
+                device_replay=True, superstep_k=4, superstep_pipeline=2)
     base.update(kw)
     return Config(**base)
 
 
 def hard_exploration_config(game: str = "MontezumaRevenge", **kw) -> Config:
-    """configs[2]: hard-exploration Atari, 256 actors."""
+    """configs[2]: hard-exploration Atari, 256 actors.  superstep_k=4:
+    see pong_config's lag rationale (CURVES_AB_PIPELINE_r04*)."""
     base = dict(game_name=game, num_actors=256, env_workers=16,
                 actor_fleets=4,
-                device_replay=True, superstep_k=16, superstep_pipeline=2)
+                device_replay=True, superstep_k=4, superstep_pipeline=2)
     base.update(kw)
     return Config(**_clamp_fleets(base, kw))
 

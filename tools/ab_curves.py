@@ -6,7 +6,12 @@ threaded fabric, then the checkpoint sweep produces its curve.  The
 artifact holds every variant's curve plus a summary (late-mean reward) so
 defaults can be justified by data (VERDICT r3 weak-items 5 and 6).
 
-Run:  python tools/ab_curves.py OUT.json NAME=k:v,k:v [NAME=...] [--seeds 1]
+Run:  python tools/ab_curves.py OUT.json NAME=k:v,k:v [NAME=...]
+          [--seeds 1] [--seed-base 0]
+
+``--seed-base`` offsets the seed range so an existing artifact can be
+extended with genuinely fresh seeds (``--seeds 2 --seed-base 1`` runs
+seeds 1 and 2).
 e.g.  python tools/ab_curves.py CURVES_AB_PIPELINE_r04.json \
           baseline=superstep_k:1,superstep_pipeline:0 \
           k4p2=superstep_k:4,superstep_pipeline:2 \
@@ -65,7 +70,8 @@ def run_variant(name: str, overrides: dict, seed: int) -> dict:
           f"overrides={overrides})", flush=True)
     metrics = train(cfg, env_factory=env_factory, checkpoint_dir=ckpt_dir,
                     verbose=False)
-    assert not metrics["fabric_failed"], f"fabric failed for {name}"
+    assert not metrics["fabric_failed"], (
+        f"fabric failed for {name}: health={metrics.get('health')}")
     curve = evaluate_sweep(cfg, ckpt_dir, env_factory, episodes=5,
                            action_dim=A)
     shutil.rmtree(ckpt_dir, ignore_errors=True)
@@ -80,11 +86,16 @@ def run_variant(name: str, overrides: dict, seed: int) -> dict:
 
 
 def main(argv) -> None:
-    seeds = 1
-    if "--seeds" in argv:
-        i = argv.index("--seeds")
-        seeds = int(argv[i + 1])
-        argv = argv[:i] + argv[i + 2:]
+    seeds, seed_base = 1, 0
+    for flag in ("--seeds", "--seed-base"):
+        if flag in argv:
+            i = argv.index(flag)
+            val = int(argv[i + 1])
+            argv = argv[:i] + argv[i + 2:]
+            if flag == "--seeds":
+                seeds = val
+            else:
+                seed_base = val
     out_path, specs = argv[0], argv[1:]
     variants = []
     for spec in specs:
@@ -97,7 +108,7 @@ def main(argv) -> None:
         variants.append((name, overrides))
 
     results = []
-    for seed in range(seeds):
+    for seed in range(seed_base, seed_base + seeds):
         for name, overrides in variants:
             results.append(run_variant(name, overrides, seed))
             # incremental write: a long grid survives interruption

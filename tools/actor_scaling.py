@@ -1,0 +1,64 @@
+"""Actor-plane scaling measurement: frames/s vs env_workers / actor_fleets.
+
+Answers VERDICT r3 item 6: how does the actor plane scale with the two
+host-parallelism knobs, per core, and is device-side acting worth it?
+Sweeps bench._actor_plane_bench (the SAME measurement as the headline
+bench — no reimplementation to drift) over a grid of ``env_workers``
+(thread-pool env stepping inside one fleet) and ``fleets`` (independent
+lockstep fleets, train.py's actor_fleets split).
+
+Default run is CPU-pinned and writes the host-scaling table to
+ACTOR_SCALING_r04.json.  ``--device`` leaves the default backend alone
+and measures ONLY the act_device cells (CPU twin vs on-device acting),
+merging them into the existing artifact instead of re-measuring — and
+overwriting — the CPU-pinned table with a different backend active.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEVICE_MODE = "--device" in sys.argv[1:]
+if not DEVICE_MODE:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax  # noqa: E402
+
+from r2d2_tpu.bench import _actor_plane_bench  # noqa: E402
+
+ITERS = 300
+PATH = "ACTOR_SCALING_r04.json"
+
+
+def cell(env_workers: int, fleets: int, act_device: str = "auto") -> dict:
+    fps = _actor_plane_bench(iterations=ITERS, env_workers=env_workers,
+                             act_device=act_device, fleets=fleets)
+    print(f"env_workers={env_workers} fleets={fleets} act={act_device}: "
+          f"{fps:,.0f} frames/s", flush=True)
+    return dict(env_workers=env_workers, actor_fleets=fleets,
+                act_device=act_device, backend=jax.default_backend(),
+                frames_per_sec=round(fps, 1))
+
+
+def main() -> None:
+    prior = json.load(open(PATH)) if os.path.exists(PATH) else dict(
+        host_cpus=os.cpu_count() or 0, lanes=64, iterations=ITERS,
+        results=[])
+    if DEVICE_MODE:
+        # the go/no-go cells only: CPU twin vs acting on the accelerator,
+        # appended to the existing host table
+        results = [cell(0, 1, "auto"), cell(0, 1, "default")]
+    else:
+        results = [cell(w, f) for w, f in
+                   [(0, 1), (2, 1), (4, 1), (8, 1), (0, 2), (0, 4), (2, 2)]]
+    prior["results"] = prior.get("results", []) + results
+    with open(PATH, "w") as f:
+        json.dump(prior, f, indent=1)
+    print(f"→ {PATH}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

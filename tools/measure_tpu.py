@@ -47,6 +47,97 @@ from r2d2_tpu.models.network import R2D2Network, create_network, init_params
 from r2d2_tpu.utils.batch import synthetic_batch
 
 
+def pallas_lstm_section(quick: bool) -> None:
+    """On-chip validation of the fused Pallas LSTM (ops/lstm.py) against
+    the scan recurrence behind the same parameters, at flagship shapes
+    (B=64, T=85, H=512, bf16 compute — the cuDNN-LSTM analogue,
+    reference model.py:51).  ``quick`` shrinks shapes and interprets the
+    kernel so the section itself smokes on CPU."""
+    from r2d2_tpu.models.network import LSTMLayer
+
+    B, T, H, F = (64, 85, 512, 512) if not quick else (4, 6, 16, 16)
+    cd = jnp.bfloat16
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32) * 0.1)
+    h0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * 0.1)
+    c0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * 0.1)
+
+    scan_l = LSTMLayer(H, compute_dtype=cd, impl="scan")
+    pal_l = LSTMLayer(H, compute_dtype=cd, impl="pallas", interpret=quick)
+    params = scan_l.init(jax.random.PRNGKey(0), xs, h0, c0)
+
+    def run(layer):
+        return jax.jit(lambda p, x, h, c: layer.apply(p, x, h, c))
+
+    def grads(layer):
+        def loss(p, x, h, c):
+            hs, (hT, cT) = layer.apply(p, x, h, c)
+            return (hs * hs).mean() + (hT * cT).mean()
+
+        return jax.jit(jax.grad(loss, argnums=(0, 2, 3)))
+
+    # one jitted executable per (layer, fwd/grad) — the equality checks
+    # and timing loops below share them, so each flagship graph compiles
+    # exactly once on the chip
+    f_scan, f_pal = run(scan_l), run(pal_l)
+    g_scan, g_pal = grads(scan_l), grads(pal_l)
+
+    # equality: fwd (inference path), then grads (training path — runs the
+    # residual-streaming fwd + reverse-grid bwd kernels)
+    hs_s, (hT_s, cT_s) = f_scan(params, xs, h0, c0)
+    hs_p, (hT_p, cT_p) = f_pal(params, xs, h0, c0)
+    for a, b_, nm in ((hs_s, hs_p, "hs"), (hT_s, hT_p, "hT"),
+                      (cT_s, cT_p, "cT")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-2, atol=2e-2, err_msg=nm)
+    g_s = g_scan(params, xs, h0, c0)
+    g_p = g_pal(params, xs, h0, c0)
+    jax.tree.map(lambda a, b_: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b_), rtol=5e-2, atol=5e-3), g_s, g_p)
+    print("pallas LSTM: fwd/infer/bwd MATCH scan at bf16 tolerance "
+          f"(B={B} T={T} H={H})", flush=True)
+
+    # timing: the already-compiled executables, median of reps, fetch-fenced
+    def time_layer(f, reps=30):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            hs, (hT, _) = f(params, xs, h0, c0)
+            np.asarray(hT[0, 0])
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times)) * 1000
+
+    t_scan, t_pal = time_layer(f_scan), time_layer(f_pal)
+    print(f"pallas LSTM fwd timing: scan {t_scan:.2f} ms, pallas "
+          f"{t_pal:.2f} ms → {t_scan / t_pal:.2f}x", flush=True)
+
+    def time_grads(g, reps=20):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = g(params, xs, h0, c0)
+            np.asarray(jax.tree.leaves(out)[1]).ravel()[0]
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times)) * 1000
+
+    tg_scan, tg_pal = time_grads(g_scan), time_grads(g_pal)
+    print(f"pallas LSTM fwd+bwd timing: scan {tg_scan:.2f} ms, pallas "
+          f"{tg_pal:.2f} ms → {tg_scan / tg_pal:.2f}x", flush=True)
+
+    # pallas_spmd: the shard_map wrapping must lower and agree on a
+    # 1-device dp mesh (the only mesh this sandbox can offer the chip)
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    spmd_l = LSTMLayer(H, compute_dtype=cd, impl="pallas",
+                       interpret=quick, spmd_mesh=mesh)
+    hs_m, (hT_m, cT_m) = run(spmd_l)(params, xs, h0, c0)
+    np.testing.assert_allclose(np.asarray(hs_m), np.asarray(hs_p),
+                               rtol=2e-2, atol=2e-2)
+    print("pallas_spmd: shard_map-wrapped kernel lowered and matches "
+          "on a dp=1 mesh", flush=True)
+
+
 def main(quick: bool = False) -> None:
     print("devices:", jax.devices(), flush=True)
     cfg = Config() if not quick else test_config()
@@ -116,6 +207,19 @@ def main(quick: bool = False) -> None:
           f"ratio {t128 / t64:.2f} (double-unroll fusion pays if << 2)",
           flush=True)
 
+    # --- 2b. Pallas fused LSTM, NON-interpret: equality vs scan at the
+    # flagship shapes (fwd/infer/bwd) + measured speedup + one
+    # pallas_spmd shard_map lowering on a 1-device dp mesh (VERDICT r3
+    # item 3 — these had only ever run interpreted on CPU).
+    try:
+        pallas_lstm_section(quick)
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(f"pallas LSTM section FAILED: {type(e).__name__}: {e}",
+              flush=True)
+
     # --- 3. learner micro — the EXACT headline measurement from bench.py
     # (AOT compile, finite-loss guard), not a drifting reimplementation.
     # quick mode times a few steps of the tiny-config step inline instead
@@ -150,9 +254,12 @@ def main(quick: bool = False) -> None:
     import tune_system
 
     tune_system.main(seconds=60.0, grid=[
-        (True, 16, 64, 0, 2),
+        (True, 4, 64, 0, 2),    # the learning presets' cell (post
+                                # CURVES_AB_PIPELINE_r04 lag A/B)
+        (True, 8, 64, 0, 2),
+        (True, 16, 64, 0, 2),   # throughput-ceiling cells
         (True, 32, 64, 0, 2),
-        (True, 16, 64, 0, 1),
+        (True, 4, 64, 0, 1),
     ], out="measure_tpu_grid.json")  # never clobber a full sweep's JSON
 
     # --- 5. actor plane ---
