@@ -128,7 +128,10 @@ class LSTMLayer(nn.Module):
     - ``impl="pallas"``: the fused Pallas kernel (ops/lstm.py) — the whole
       unroll is one TPU program with the recurrent weights and h/c held in
       VMEM across steps, removing the per-step kernel overhead and HBM
-      re-reads of the scan (~4x faster on v5e at flagship shapes).
+      re-reads of the scan (~4x faster on v5e at flagship shapes — r2
+      measurement of an earlier kernel revision;
+      tools/measure_tpu.py:pallas_lstm_section re-measures the current
+      one on a healthy chip).
     """
     hidden_dim: int
     compute_dtype: Any = jnp.float32
