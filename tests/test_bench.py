@@ -64,3 +64,32 @@ def test_bench_reports_unreachable_device_as_artifact(monkeypatch, capsys):
     result = json.loads(out[0])
     assert result["value"] == -1.0
     assert "unreachable" in result["error"]
+
+
+def test_actor_plane_bench_fleet_split_counts_all_lanes(monkeypatch):
+    """The fleets/env_workers/act_device knobs (tools/actor_scaling.py's
+    sweep surface) must keep the frames accounting exact: every lane lands
+    in exactly one fleet and every fleet runs exactly ``iterations`` timed
+    steps (plus the fixed warmup)."""
+    import r2d2_tpu.actor as actor_mod
+    from r2d2_tpu import bench
+
+    created = []
+    real = actor_mod.VectorActor
+
+    class Recording(real):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            created.append(self)
+
+    monkeypatch.setattr(actor_mod, "VectorActor", Recording)
+    for fleets, workers in ((1, 0), (2, 2)):
+        created.clear()
+        fps = bench._actor_plane_bench(iterations=6, num_lanes=8,
+                                       env_workers=workers, fleets=fleets,
+                                       act_device="cpu")
+        assert fps > 0
+        assert len(created) == fleets
+        assert sum(a.N for a in created) == 8  # no lane dropped
+        # warmup (20) + timed window (6) lockstep iterations per fleet
+        assert all(a.actor_steps == 26 for a in created)

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Probe the tunnel on a 10-min cadence; the moment it answers, fire the
+# measurement battery (tools/measure_tpu.py), then the headline bench.
+# One TPU process at a time, all internally bounded, never killed
+# externally (axon tunnel discipline).
+cd /root/repo
+python tools/probe_loop.py 600 180 12 || { echo "probe gave up" >> tools/probe_status.jsonl; exit 1; }
+echo "{\"event\": \"tunnel healthy — starting battery $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
+python tools/measure_tpu.py > /tmp/measure_tpu_r04.log 2>&1
+echo "{\"event\": \"battery done rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
+python bench.py > /tmp/bench_r04_preview.json 2> /tmp/bench_r04_preview.err
+echo "{\"event\": \"bench done rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl

@@ -1,0 +1,83 @@
+"""Full-fabric soak: run the threaded production composition for a wall
+budget and assert steady-state health.
+
+Evidence artifact for fabric stability (README's soak claim): two actor
+fleets + env workers + device-resident replay + fused super-steps +
+pipelined harvest, on the fake env, CPU-pinned unless ``--device``.
+Checks at exit: zero fabric failures, exact priority accounting (buffer
+counter == learner counter), no throughput decay (last-third updates/s
+within 20% of the middle third), and prints the health/trace summary.
+
+Run:  python tools/soak.py [minutes] [--device] [--out OUT.json]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+args = [a for a in sys.argv[1:] if not a.startswith("--")]
+DEVICE = "--device" in sys.argv[1:]
+OUT = None
+if "--out" in sys.argv[1:]:
+    OUT = sys.argv[sys.argv.index("--out") + 1]
+if not DEVICE:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from r2d2_tpu.config import test_config  # noqa: E402
+from r2d2_tpu.envs.fake import FakeAtariEnv  # noqa: E402
+from r2d2_tpu.train import train  # noqa: E402
+
+
+def main(minutes: float = 20.0) -> int:
+    cfg = test_config(
+        game_name="Fake", num_actors=32, hidden_dim=128,
+        obs_shape=(24, 24, 1), torso="mlp", batch_size=32,
+        burn_in_steps=8, learning_steps=8, forward_steps=2,
+        block_length=32, buffer_capacity=25600, learning_starts=1600,
+        device_replay=True, superstep_k=4, superstep_pipeline=2,
+        actor_fleets=2, env_workers=2,
+        training_steps=10**9, log_interval=10.0)
+    t0 = time.time()
+    m = train(cfg, env_factory=lambda c, s: FakeAtariEnv(
+                  obs_shape=c.stored_obs_shape, action_dim=4, seed=s,
+                  episode_len=200),
+              max_wall_seconds=minutes * 60.0, verbose=False)
+    wall = time.time() - t0
+
+    rates = [e["updates_per_sec"] for e in m["logs"]
+             if e["updates_per_sec"] > 0]
+    third = max(1, len(rates) // 3)
+    mid = float(np.median(rates[third:2 * third]))
+    last = float(np.median(rates[-third:]))
+    ok_decay = last >= 0.8 * mid if rates else False
+    ok_failures = not m["fabric_failed"]
+    ok_priorities = m["buffer_training_steps"] == m["num_updates"]
+
+    summary = dict(
+        minutes=round(wall / 60.0, 1),
+        num_updates=int(m["num_updates"]),
+        env_steps=int(m["env_steps"]),
+        updates_per_sec_mid=round(mid, 1) if rates else None,
+        updates_per_sec_last=round(last, 1) if rates else None,
+        fabric_failed=m["fabric_failed"],
+        priority_accounting_exact=ok_priorities,
+        no_throughput_decay=ok_decay,
+        health=m["health"],
+    )
+    print(json.dumps(summary, indent=1))
+    if OUT:
+        with open(OUT, "w") as f:
+            json.dump(summary, f, indent=1)
+    ok = ok_failures and ok_priorities and ok_decay
+    print("SOAK", "PASS" if ok else "FAIL", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(float(args[0]) if args else 20.0))
