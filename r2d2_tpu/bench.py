@@ -280,6 +280,10 @@ def main(steps: int = 100, warmup: int = 5,
         }))
         sys.exit(1)
 
+    from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
+
+    enable_compile_cache()  # repeat bench runs skip the multi-second compiles
+
     import jax
 
     dev = jax.devices()[0]

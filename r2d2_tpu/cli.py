@@ -94,6 +94,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
+
+    enable_compile_cache()  # warm starts: persist multi-second XLA compiles
     parser = argparse.ArgumentParser(prog="r2d2_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 

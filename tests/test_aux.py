@@ -153,3 +153,36 @@ def test_checkpoint_arch_compat_guard(tmp_path):
                            obs_space_to_depth=True)
     with pytest.raises(ValueError, match="obs_space_to_depth"):
         check_arch_compat(s2d, ck.peek_meta())
+
+
+def test_compile_cache_enable_and_disable(tmp_path, monkeypatch):
+    """compile_cache.enable honors the path arg and the off switch, and
+    actually points jax at the directory (warm-start machinery)."""
+    import os
+
+    import jax
+
+    from r2d2_tpu.utils import compile_cache
+
+    # jax.config mutations outlive monkeypatch: restore them explicitly
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+
+    d = str(tmp_path / "xla")
+    monkeypatch.delenv("R2D2_COMPILE_CACHE", raising=False)
+    assert compile_cache.enable(d) == d
+    assert os.path.isdir(d)
+    assert jax.config.jax_compilation_cache_dir == d
+
+    monkeypatch.setenv("R2D2_COMPILE_CACHE", "0")
+    assert compile_cache.enable() is None
+
+    monkeypatch.setenv("R2D2_COMPILE_CACHE", str(tmp_path / "env_xla"))
+    assert compile_cache.enable() == str(tmp_path / "env_xla")
+
+    # explicit path wins even over the env off-switch (documented precedence)
+    monkeypatch.setenv("R2D2_COMPILE_CACHE", "0")
+    assert compile_cache.enable(d) == d
+
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)

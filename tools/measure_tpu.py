@@ -139,6 +139,9 @@ def pallas_lstm_section(quick: bool) -> None:
 
 
 def main(quick: bool = False) -> None:
+    from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
+
+    enable_compile_cache()
     print("devices:", jax.devices(), flush=True)
     cfg = Config() if not quick else test_config()
     A = 9 if not quick else 4  # MsPacman minimal action set

@@ -17,11 +17,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-args = [a for a in sys.argv[1:] if not a.startswith("--")]
-DEVICE = "--device" in sys.argv[1:]
+_argv = sys.argv[1:]
+DEVICE = "--device" in _argv
 OUT = None
-if "--out" in sys.argv[1:]:
-    OUT = sys.argv[sys.argv.index("--out") + 1]
+if "--out" in _argv:
+    i = _argv.index("--out")
+    OUT = _argv[i + 1]
+    _argv = _argv[:i] + _argv[i + 2:]
+args = [a for a in _argv if not a.startswith("--")]
 if not DEVICE:
     import jax
 
@@ -35,6 +38,9 @@ from r2d2_tpu.train import train  # noqa: E402
 
 
 def main(minutes: float = 20.0) -> int:
+    from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
+
+    enable_compile_cache()  # device soaks must not repay the big compiles
     cfg = test_config(
         game_name="Fake", num_actors=32, hidden_dim=128,
         obs_shape=(24, 24, 1), torso="mlp", batch_size=32,
@@ -52,10 +58,17 @@ def main(minutes: float = 20.0) -> int:
 
     rates = [e["updates_per_sec"] for e in m["logs"]
              if e["updates_per_sec"] > 0]
-    third = max(1, len(rates) // 3)
-    mid = float(np.median(rates[third:2 * third]))
-    last = float(np.median(rates[-third:]))
-    ok_decay = last >= 0.8 * mid if rates else False
+    if len(rates) >= 3:
+        third = len(rates) // 3
+        mid = float(np.median(rates[third:2 * third]))
+        last = float(np.median(rates[-third:]))
+        ok_decay = last >= 0.8 * mid
+    elif rates:  # run too short to split into thirds: no decay signal
+        mid = last = float(np.median(rates))
+        ok_decay = True
+    else:
+        mid = last = None
+        ok_decay = False
     ok_failures = not m["fabric_failed"]
     ok_priorities = m["buffer_training_steps"] == m["num_updates"]
 
@@ -63,8 +76,8 @@ def main(minutes: float = 20.0) -> int:
         minutes=round(wall / 60.0, 1),
         num_updates=int(m["num_updates"]),
         env_steps=int(m["env_steps"]),
-        updates_per_sec_mid=round(mid, 1) if rates else None,
-        updates_per_sec_last=round(last, 1) if rates else None,
+        updates_per_sec_mid=round(mid, 1) if mid is not None else None,
+        updates_per_sec_last=round(last, 1) if last is not None else None,
         fabric_failed=m["fabric_failed"],
         priority_accounting_exact=ok_priorities,
         no_throughput_decay=ok_decay,
