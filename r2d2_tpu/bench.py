@@ -297,8 +297,11 @@ def main(steps: int = 100, warmup: int = 5,
     except Exception:
         traceback.print_exc()
         actor_fps = -1.0
+    system_knobs = dict(device_replay=True, superstep_k=4,
+                        superstep_pipeline=2, num_actors=64, env_workers=0)
     try:
-        system_fps, top_spans, sys_updates = _system_bench(system_seconds)
+        system_fps, top_spans, sys_updates = _system_bench(system_seconds,
+                                                           **system_knobs)
     except Exception:
         traceback.print_exc()
         system_fps, top_spans, sys_updates = -1.0, {}, 0
@@ -310,6 +313,10 @@ def main(steps: int = 100, warmup: int = 5,
         "vs_baseline": round(learner_fps / NORTH_STAR_FPS, 3),
         "system_env_frames_per_sec": round(system_fps, 1),
         "system_vs_baseline": round(system_fps / NORTH_STAR_FPS, 3),
+        # the exact fabric knobs behind the system number (the learning
+        # presets' cell — CURVES_AB_PIPELINE_r04's k=4 choice), so the
+        # artifact documents what was measured
+        "system_knobs": system_knobs,
         "actor_env_frames_per_sec": round(actor_fps, 1),
         # the actor/system planes are host-CPU-bound work: their numbers
         # only compare across machines with this context attached

@@ -61,6 +61,7 @@ class Learner:
         self.env_steps = start_env_steps
         self.start_minutes = start_minutes
         self._replicate_params = None  # lazily-built multihost resharder
+        self._copy_params = None       # lazily-built one-dispatch snapshotter
 
         if mesh is not None:
             self._step_fn = sharded_train_step(cfg, net, mesh,
@@ -103,8 +104,15 @@ class Learner:
             self.param_store.publish(jax.device_get(
                 self._replicate_params(self.state.params)))
         else:
-            self.param_store.publish(
-                jax.tree.map(jnp.copy, self.state.params))
+            if self._copy_params is None:
+                # one jitted executable for the whole-tree copy: a bare
+                # tree_map of jnp.copy issues one dispatch PER LEAF, which
+                # on a tunneled/remote link puts ~leaf-count round-trip
+                # overheads on the dispatch path every publish (and k=4
+                # publishes once per super-step dispatch)
+                self._copy_params = jax.jit(
+                    lambda p: jax.tree.map(jnp.copy, p))
+            self.param_store.publish(self._copy_params(self.state.params))
 
     @property
     def num_updates(self) -> int:

@@ -164,25 +164,29 @@ def test_compile_cache_enable_and_disable(tmp_path, monkeypatch):
 
     from r2d2_tpu.utils import compile_cache
 
-    # jax.config mutations outlive monkeypatch: restore them explicitly
+    # jax.config mutations outlive monkeypatch: restore them explicitly,
+    # on failure paths too (a leaked deleted tmp dir would cascade
+    # cache-write noise into every later test)
     prev_dir = jax.config.jax_compilation_cache_dir
     prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        d = str(tmp_path / "xla")
+        monkeypatch.delenv("R2D2_COMPILE_CACHE", raising=False)
+        assert compile_cache.enable(d) == d
+        assert os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
 
-    d = str(tmp_path / "xla")
-    monkeypatch.delenv("R2D2_COMPILE_CACHE", raising=False)
-    assert compile_cache.enable(d) == d
-    assert os.path.isdir(d)
-    assert jax.config.jax_compilation_cache_dir == d
+        monkeypatch.setenv("R2D2_COMPILE_CACHE", "0")
+        assert compile_cache.enable() is None
 
-    monkeypatch.setenv("R2D2_COMPILE_CACHE", "0")
-    assert compile_cache.enable() is None
+        monkeypatch.setenv("R2D2_COMPILE_CACHE", str(tmp_path / "env_xla"))
+        assert compile_cache.enable() == str(tmp_path / "env_xla")
 
-    monkeypatch.setenv("R2D2_COMPILE_CACHE", str(tmp_path / "env_xla"))
-    assert compile_cache.enable() == str(tmp_path / "env_xla")
-
-    # explicit path wins even over the env off-switch (documented precedence)
-    monkeypatch.setenv("R2D2_COMPILE_CACHE", "0")
-    assert compile_cache.enable(d) == d
-
-    jax.config.update("jax_compilation_cache_dir", prev_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+        # explicit path wins even over the env off-switch (documented
+        # precedence)
+        monkeypatch.setenv("R2D2_COMPILE_CACHE", "0")
+        assert compile_cache.enable(d) == d
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)

@@ -40,7 +40,7 @@ def test_bench_json_line_is_first_stdout_line(monkeypatch, capsys):
                         lambda steps, warmup: (50000.0, 10.0, 0.0))
     monkeypatch.setattr(bench, "_actor_plane_bench", lambda: 1.0)
     monkeypatch.setattr(bench, "_system_bench",
-                        lambda s: (2.0, {}, 3))
+                        lambda s, **kw: (2.0, {}, 3))
     bench.main(steps=1, warmup=0, system_seconds=0.1)
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
     parsed = json.loads(lines[0])

@@ -168,3 +168,20 @@ def test_gradients_do_not_flow_into_target_selection():
     grads = jax.grad(loss_wrt_target)(target_params)
     assert max(jax.tree.leaves(jax.tree.map(
         lambda g: float(jnp.abs(g).max()), grads))) == 0.0
+
+
+def test_published_snapshot_survives_state_donation():
+    """Learner._publish's one-dispatch jitted tree-copy must produce
+    buffers genuinely distinct from the (donated) train state: a later
+    step reusing the donated buffers must not clobber what actors hold."""
+    import jax
+    import jax.numpy as jnp
+
+    copy_fn = jax.jit(lambda p: jax.tree.map(jnp.copy, p))
+    x = {"w": jnp.arange(8, dtype=jnp.float32)}
+    snap = copy_fn(x)
+    step = jax.jit(lambda p: jax.tree.map(lambda a: a * 0 - 1, p),
+                   donate_argnums=0)
+    step(x)  # donates x's buffers — snap must be unaffected
+    np.testing.assert_array_equal(np.asarray(snap["w"]),
+                                  np.arange(8, dtype=np.float32))

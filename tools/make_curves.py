@@ -49,8 +49,8 @@ def main(out_path: str = None, fabric: bool = False) -> None:
     if out_path is None:
         # mode-derived default so `--fabric` can never silently overwrite
         # the deterministic-trainer evidence artifact
-        out_path = ("CURVES_FABRIC_r03.json" if fabric
-                    else "CURVES_r03.json")
+        out_path = ("CURVES_FABRIC_r04.json" if fabric
+                    else "CURVES_r04.json")
     # lr is deliberately NOT the reference's 1e-4: that value is tuned for
     # Atari-scale nets and batch 64, and at this toy scale (hidden 32,
     # batch 8) it plateaus barely above random within any reasonable CPU

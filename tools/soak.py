@@ -22,6 +22,8 @@ DEVICE = "--device" in _argv
 OUT = None
 if "--out" in _argv:
     i = _argv.index("--out")
+    if i + 1 >= len(_argv):
+        sys.exit("usage: soak.py [minutes] [--device] [--out OUT.json]")
     OUT = _argv[i + 1]
     _argv = _argv[:i] + _argv[i + 2:]
 args = [a for a in _argv if not a.startswith("--")]
