@@ -20,4 +20,4 @@ from r2d2_tpu.checkpoint import Checkpointer
 from r2d2_tpu.evaluate import evaluate_params, evaluate_sweep
 from r2d2_tpu.train import train, train_sync
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
