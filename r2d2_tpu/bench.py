@@ -273,11 +273,35 @@ def main(steps: int = 100, warmup: int = 5,
 
     ok, reason = _device_probe()
     if not ok:
-        print(json.dumps({
+        artifact = {
             "metric": "learner_env_frames_per_sec",
             "value": -1.0, "unit": "frames/s", "vs_baseline": -1.0,
             "error": f"accelerator backend unreachable ({reason})",
-        }))
+        }
+        # attach the CURRENT probe run's history (tools/probe_then_measure
+        # writes one JSON line per bounded probe attempt) so an outage
+        # artifact also documents how long the backend has been down.  The
+        # status file is append-only across runs; attempt numbering
+        # restarts at 1 per run, so slice from the last attempt==1.
+        try:
+            here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            with open(os.path.join(here, "tools",
+                                   "probe_status.jsonl")) as f:
+                lines = [json.loads(ln) for ln in f if ln.strip()]
+            attempts = [e for e in lines if "attempt" in e]
+            starts = [i for i, e in enumerate(attempts)
+                      if e.get("attempt") == 1]
+            if starts:
+                attempts = attempts[starts[-1]:]
+            if attempts:
+                artifact["probe_attempts"] = len(attempts)
+                artifact["probed_from_to"] = (attempts[0].get("t"),
+                                              attempts[-1].get("t"))
+                artifact["any_probe_succeeded"] = any(e.get("ok")
+                                                      for e in attempts)
+        except Exception:
+            pass
+        print(json.dumps(artifact))
         sys.exit(1)
 
     from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache

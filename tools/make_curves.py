@@ -8,15 +8,19 @@ north star: ALE is not installed here, so the fake env's learnable POMDP
 (envs/fake.py) stands in — the curve must show reward rising from the
 random baseline to near-optimal.
 
-Two modes:
+Modes (composable):
 - default: the deterministic single-process trainer (``train_sync``) —
   reproducible reference semantics.
 - ``--fabric``: the full threaded production fabric (``train``) with
   device-resident replay, fused super-steps, the pipelined result
   harvest, and two actor fleets — evidence that the concurrent system,
   not just the deterministic interleaving, learns.
+- ``--nature``: the production network family instead of the MLP
+  stand-in — 44×44 frames space-to-depth to (11,11,16), Nature conv
+  pyramid, LSTM-128 — evidence that the full conv+LSTM stack learns
+  end-to-end.
 
-Run:  python tools/make_curves.py [out.json] [--fabric]
+Run:  python tools/make_curves.py [out.json] [--fabric] [--nature]
 """
 import json
 import os
@@ -45,12 +49,17 @@ def env_factory(cfg, seed):
                         seed=seed, episode_len=32)
 
 
-def main(out_path: str = None, fabric: bool = False) -> None:
+def main(out_path: str = None, fabric: bool = False,
+         torso: str = "mlp") -> None:
     if out_path is None:
-        # mode-derived default so `--fabric` can never silently overwrite
-        # the deterministic-trainer evidence artifact
-        out_path = ("CURVES_FABRIC_r04.json" if fabric
-                    else "CURVES_r04.json")
+        # mode-derived defaults so `--fabric`/`--nature` can never
+        # silently overwrite another mode's evidence artifact
+        if torso == "nature":
+            out_path = ("CURVES_NATURE_FABRIC_r04.json" if fabric
+                        else "CURVES_NATURE_r04.json")
+        else:
+            out_path = ("CURVES_FABRIC_r04.json" if fabric
+                        else "CURVES_r04.json")
     # lr is deliberately NOT the reference's 1e-4: that value is tuned for
     # Atari-scale nets and batch 64, and at this toy scale (hidden 32,
     # batch 8) it plateaus barely above random within any reasonable CPU
@@ -60,6 +69,13 @@ def main(out_path: str = None, fabric: bool = False) -> None:
         game_name="Fake", training_steps=2000, save_interval=80,
         lr=3e-3, hidden_dim=32,
         eval_episodes=5, max_episode_steps=64, seed=0)
+    if torso == "nature":
+        # the full conv+LSTM stack (not the MLP stand-in): 44×44 frames
+        # space-to-depth to (11,11,16), Nature conv pyramid, LSTM-128 —
+        # evidence that the production network family learns end-to-end
+        cfg = cfg.replace(torso="nature", obs_shape=(44, 44, 1),
+                          obs_space_to_depth=True, hidden_dim=128,
+                          batch_size=16)
     if fabric:
         # the full concurrent system: device ring + fused super-steps +
         # pipelined harvest + two actor fleets.  save_interval stays dense
@@ -105,6 +121,11 @@ def main(out_path: str = None, fabric: bool = False) -> None:
                     save_interval=cfg.save_interval,
                     batch_size=cfg.batch_size, seed=cfg.seed,
                     num_actors=cfg.num_actors,
+                    # network family: the artifact must document what
+                    # learned (the --nature evidence is about the torso)
+                    torso=cfg.torso, obs_shape=list(cfg.obs_shape),
+                    obs_space_to_depth=cfg.obs_space_to_depth,
+                    hidden_dim=cfg.hidden_dim,
                     # fabric knobs only when the fabric ran them —
                     # train_sync forces pipeline 0 / no supersteps
                     **(dict(actor_fleets=cfg.actor_fleets,
@@ -131,5 +152,7 @@ def main(out_path: str = None, fabric: bool = False) -> None:
 
 
 if __name__ == "__main__":
-    args = [a for a in sys.argv[1:] if a != "--fabric"]
-    main(args[0] if args else None, fabric="--fabric" in sys.argv[1:])
+    torso = "nature" if "--nature" in sys.argv[1:] else "mlp"
+    args = [a for a in sys.argv[1:] if a not in ("--fabric", "--nature")]
+    main(args[0] if args else None, fabric="--fabric" in sys.argv[1:],
+         torso=torso)

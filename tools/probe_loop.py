@@ -29,7 +29,7 @@ def main(interval: float = 600.0, probe_timeout: float = 180.0,
         attempt += 1
         t0 = time.time()
         ok, reason = _device_probe(timeout_s=probe_timeout)
-        line = {"t": time.strftime("%H:%M:%S"), "attempt": attempt,
+        line = {"t": time.strftime("%Y-%m-%d %H:%M:%S"), "attempt": attempt,
                 "ok": ok, "reason": reason,
                 "probe_secs": round(time.time() - t0, 1)}
         with open(STATUS, "a") as f:
