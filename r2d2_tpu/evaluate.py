@@ -94,7 +94,7 @@ def evaluate_sweep(cfg: Config,
                    out_plot: Optional[str] = None,
                    action_dim: Optional[int] = None,
                    follow: bool = False,
-                   follow_timeout: Optional[float] = None,
+                   follow_timeout: Optional[float] = 600.0,
                    poll_interval: float = 2.0,
                    stop: Optional[Callable[[], bool]] = None
                    ) -> List[Dict[str, float]]:
@@ -107,7 +107,9 @@ def evaluate_sweep(cfg: Config,
     after draining the checkpoints already on disk it keeps polling for new
     ones, evaluating each as it appears, and exits when ``stop()`` reports
     training finished (with one final drain) or when no new checkpoint has
-    appeared for ``follow_timeout`` seconds.  ``out_json`` is rewritten
+    appeared for ``follow_timeout`` seconds (default 600 — pass ``None``
+    to poll until ``stop()`` alone, which without a stop callback polls
+    forever).  ``out_json`` is rewritten
     after every record in follow mode so the curve file trails the run too.
     A step is only picked up once its metadata sidecar exists — process 0
     writes that after the orbax save, so its presence marks a finished save.

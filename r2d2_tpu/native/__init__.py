@@ -42,16 +42,20 @@ def _build() -> Optional[str]:
         return None
     # content-keyed cache: mtimes collide across wheel builds
     # (SOURCE_DATE_EPOCH) and same-second edits, silently loading stale code
-    out = os.path.join(_cache_dir(), f"sumtree_{digest}.so")
+    # uid-scoped filename: users sharing a cache dir never collide, and a
+    # pre-planted file under our exact name still fails the ownership
+    # check below and is rebuilt over (never silently loaded)
+    out = os.path.join(_cache_dir(), f"sumtree_{digest}_u{os.getuid()}.so")
     if os.path.exists(out):
         # only trust a cached .so we own: a writable shared cache path must
         # not let a pre-planted file be ctypes-loaded into the process
         try:
-            if os.stat(out).st_uid != os.getuid():
-                return None
+            if os.stat(out).st_uid == os.getuid():
+                return out
         except OSError:
             return None
-        return out
+        # foreign-owned file under our name: fall through and rebuild over
+        # it (os.replace) instead of permanently disabling the fast path
     cc = os.environ.get("CC", "cc")
     try:
         os.makedirs(_cache_dir(), mode=0o700, exist_ok=True)

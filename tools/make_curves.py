@@ -15,12 +15,14 @@ Modes (composable):
   device-resident replay, fused super-steps, the pipelined result
   harvest, and two actor fleets — evidence that the concurrent system,
   not just the deterministic interleaving, learns.
-- ``--nature``: the production network family instead of the MLP
-  stand-in — 44×44 frames space-to-depth to (11,11,16), Nature conv
-  pyramid, LSTM-128 — evidence that the full conv+LSTM stack learns
-  end-to-end.
+- ``--nature``: the Nature conv family instead of the MLP stand-in —
+  44×44 frames space-to-depth to (11,11,16), Nature conv pyramid,
+  LSTM-128 — evidence the full conv+LSTM stack learns end-to-end.
+- ``--impala``: the deep residual family (BASELINE configs[4] shape) —
+  raw 44×44 frames, IMPALA residual stacks, 2-layer LSTM with remat.
+  Mutually exclusive with ``--nature``.
 
-Run:  python tools/make_curves.py [out.json] [--fabric] [--nature]
+Run:  python tools/make_curves.py [out.json] [--fabric] [--nature|--impala]
 """
 import json
 import os
@@ -54,9 +56,10 @@ def main(out_path: str = None, fabric: bool = False,
     if out_path is None:
         # mode-derived defaults so `--fabric`/`--nature` can never
         # silently overwrite another mode's evidence artifact
-        if torso == "nature":
-            out_path = ("CURVES_NATURE_FABRIC_r04.json" if fabric
-                        else "CURVES_NATURE_r04.json")
+        if torso in ("nature", "impala"):
+            up = torso.upper()
+            out_path = (f"CURVES_{up}_FABRIC_r04.json" if fabric
+                        else f"CURVES_{up}_r04.json")
         else:
             out_path = ("CURVES_FABRIC_r04.json" if fabric
                         else "CURVES_r04.json")
@@ -76,6 +79,13 @@ def main(out_path: str = None, fabric: bool = False,
         cfg = cfg.replace(torso="nature", obs_shape=(44, 44, 1),
                           obs_space_to_depth=True, hidden_dim=128,
                           batch_size=16)
+    elif torso == "impala":
+        # the deep residual family (BASELINE configs[4]): raw 44×44
+        # frames, IMPALA residual stacks, 2-layer LSTM with remat — the
+        # long-context preset's network shape at evidence scale
+        cfg = cfg.replace(torso="impala", obs_shape=(44, 44, 1),
+                          obs_space_to_depth=False, hidden_dim=96,
+                          lstm_layers=2, remat=True, batch_size=16)
     if fabric:
         # the full concurrent system: device ring + fused super-steps +
         # pipelined harvest + two actor fleets.  save_interval stays dense
@@ -152,7 +162,11 @@ def main(out_path: str = None, fabric: bool = False,
 
 
 if __name__ == "__main__":
-    torso = "nature" if "--nature" in sys.argv[1:] else "mlp"
-    args = [a for a in sys.argv[1:] if a not in ("--fabric", "--nature")]
+    if "--nature" in sys.argv[1:] and "--impala" in sys.argv[1:]:
+        sys.exit("--nature and --impala are mutually exclusive")
+    torso = ("nature" if "--nature" in sys.argv[1:]
+             else "impala" if "--impala" in sys.argv[1:] else "mlp")
+    args = [a for a in sys.argv[1:]
+            if a not in ("--fabric", "--nature", "--impala")]
     main(args[0] if args else None, fabric="--fabric" in sys.argv[1:],
          torso=torso)
