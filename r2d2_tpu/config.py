@@ -244,8 +244,8 @@ def pong_config(**kw) -> Config:
     superstep_k=4: the priority-feedback lag is ≤ (pipeline+1)·k = 12
     updates — the reference's own lag envelope (8-batch queue + 4-batch
     staging, worker.py:300-316).  k=16 (lag 48) showed a measurable
-    late-curve tax in the 3-run fabric A/B (CURVES_AB_PIPELINE_r04*:
-    late-mean 20.4 vs 25.6 baseline, k=4 at parity 25.1); k=16 remains a
+    late-curve tax in the 4-run fabric A/B (CURVES_AB_PIPELINE_r04*:
+    late-mean 22.9 vs 27.7 baseline, k=4 at parity 26.1); k=16 remains a
     throughput-bench knob, not a learning default."""
     base = dict(game_name="Pong", num_actors=64, env_workers=8,
                 device_replay=True, superstep_k=4, superstep_pipeline=2)

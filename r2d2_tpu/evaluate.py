@@ -94,7 +94,7 @@ def evaluate_sweep(cfg: Config,
                    out_plot: Optional[str] = None,
                    action_dim: Optional[int] = None,
                    follow: bool = False,
-                   follow_timeout: Optional[float] = 600.0,
+                   follow_timeout: Any = "default",
                    poll_interval: float = 2.0,
                    stop: Optional[Callable[[], bool]] = None
                    ) -> List[Dict[str, float]]:
@@ -107,13 +107,17 @@ def evaluate_sweep(cfg: Config,
     after draining the checkpoints already on disk it keeps polling for new
     ones, evaluating each as it appears, and exits when ``stop()`` reports
     training finished (with one final drain) or when no new checkpoint has
-    appeared for ``follow_timeout`` seconds (default 600 — pass ``None``
-    to poll until ``stop()`` alone, which without a stop callback polls
-    forever).  ``out_json`` is rewritten
+    appeared for ``follow_timeout`` seconds.  The timeout defaults to 600
+    when no ``stop`` callback is given (a bare follow call must not poll
+    forever) and to ``None`` — poll until ``stop()`` — when one is: a
+    live training run with a slow checkpoint cadence must not be cut
+    short.  ``out_json`` is rewritten
     after every record in follow mode so the curve file trails the run too.
     A step is only picked up once its metadata sidecar exists — process 0
     writes that after the orbax save, so its presence marks a finished save.
     """
+    if follow_timeout == "default":
+        follow_timeout = 600.0 if stop is None else None
     ckpt = Checkpointer(checkpoint_dir)
     if action_dim is None:
         action_dim = env_factory(cfg, 0).action_space.n

@@ -19,7 +19,7 @@ Modes (composable):
   44×44 frames space-to-depth to (11,11,16), Nature conv pyramid,
   LSTM-128 — evidence the full conv+LSTM stack learns end-to-end.
 - ``--impala``: the deep residual family (BASELINE configs[4] shape) —
-  raw 44×44 frames, IMPALA residual stacks, 2-layer LSTM with remat.
+  raw 24×24 frames, IMPALA residual stacks, 2-layer LSTM.
   Mutually exclusive with ``--nature``.
 
 Run:  python tools/make_curves.py [out.json] [--fabric] [--nature|--impala]
@@ -80,12 +80,15 @@ def main(out_path: str = None, fabric: bool = False,
                           obs_space_to_depth=True, hidden_dim=128,
                           batch_size=16)
     elif torso == "impala":
-        # the deep residual family (BASELINE configs[4]): raw 44×44
-        # frames, IMPALA residual stacks, 2-layer LSTM with remat — the
-        # long-context preset's network shape at evidence scale
-        cfg = cfg.replace(torso="impala", obs_shape=(44, 44, 1),
-                          obs_space_to_depth=False, hidden_dim=96,
-                          lstm_layers=2, remat=True, batch_size=16)
+        # the deep residual family (BASELINE configs[4]): raw frames,
+        # IMPALA residual stacks, 2-layer LSTM — the long-context
+        # preset's network shape at CPU-evidence scale (24px, batch 8:
+        # ~0.24 s/step; the 44px/batch-16 variant measured ~3 s/step,
+        # infeasible for a 2k-update curve on one core.  remat stays off:
+        # at these T=10 windows it only adds recompute)
+        cfg = cfg.replace(torso="impala", obs_shape=(24, 24, 1),
+                          obs_space_to_depth=False, hidden_dim=64,
+                          lstm_layers=2, batch_size=8)
     if fabric:
         # the full concurrent system: device ring + fused super-steps +
         # pipelined harvest + two actor fleets.  save_interval stays dense
