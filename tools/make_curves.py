@@ -22,7 +22,7 @@ Modes (composable):
   raw 24×24 frames, IMPALA residual stacks, 2-layer LSTM.
   Mutually exclusive with ``--nature``.
 
-Run:  python tools/make_curves.py [out.json] [--fabric] [--nature|--impala]
+Run:  python tools/make_curves.py [out.json] [--fabric] [--nature|--impala] [--seed N]
 """
 import json
 import os
@@ -52,17 +52,16 @@ def env_factory(cfg, seed):
 
 
 def main(out_path: str = None, fabric: bool = False,
-         torso: str = "mlp") -> None:
+         torso: str = "mlp", seed: int = 0) -> None:
     if out_path is None:
-        # mode-derived defaults so `--fabric`/`--nature` can never
-        # silently overwrite another mode's evidence artifact
-        if torso in ("nature", "impala"):
-            up = torso.upper()
-            out_path = (f"CURVES_{up}_FABRIC_r04.json" if fabric
-                        else f"CURVES_{up}_r04.json")
-        else:
-            out_path = ("CURVES_FABRIC_r04.json" if fabric
-                        else "CURVES_r04.json")
+        # mode-derived defaults so `--fabric`/`--nature`/`--seed` can
+        # never silently overwrite another mode's evidence artifact
+        stem = (f"CURVES_{torso.upper()}" if torso in ("nature", "impala")
+                else "CURVES")
+        if fabric:
+            stem += "_FABRIC"
+        suffix = f"_s{seed}" if seed else ""
+        out_path = f"{stem}_r04{suffix}.json"
     # lr is deliberately NOT the reference's 1e-4: that value is tuned for
     # Atari-scale nets and batch 64, and at this toy scale (hidden 32,
     # batch 8) it plateaus barely above random within any reasonable CPU
@@ -71,7 +70,7 @@ def main(out_path: str = None, fabric: bool = False,
     cfg = test_config(
         game_name="Fake", training_steps=2000, save_interval=80,
         lr=3e-3, hidden_dim=32,
-        eval_episodes=5, max_episode_steps=64, seed=0)
+        eval_episodes=5, max_episode_steps=64, seed=seed)
     if torso == "nature":
         # the full conv+LSTM stack (not the MLP stand-in): 44×44 frames
         # space-to-depth to (11,11,16), Nature conv pyramid, LSTM-128 —
@@ -165,11 +164,23 @@ def main(out_path: str = None, fabric: bool = False,
 
 
 if __name__ == "__main__":
-    if "--nature" in sys.argv[1:] and "--impala" in sys.argv[1:]:
+    argv = sys.argv[1:]
+    if "--nature" in argv and "--impala" in argv:
         sys.exit("--nature and --impala are mutually exclusive")
-    torso = ("nature" if "--nature" in sys.argv[1:]
-             else "impala" if "--impala" in sys.argv[1:] else "mlp")
-    args = [a for a in sys.argv[1:]
-            if a not in ("--fabric", "--nature", "--impala")]
-    main(args[0] if args else None, fabric="--fabric" in sys.argv[1:],
-         torso=torso)
+    torso = ("nature" if "--nature" in argv
+             else "impala" if "--impala" in argv else "mlp")
+    usage = ("usage: make_curves.py [out.json] [--fabric] "
+             "[--nature|--impala] [--seed N]")
+    seed = 0
+    if "--seed" in argv:
+        i = argv.index("--seed")
+        try:
+            seed = int(argv[i + 1])
+        except (IndexError, ValueError):
+            sys.exit(usage)
+        argv = argv[:i] + argv[i + 2:]
+    args = [a for a in argv if a not in ("--fabric", "--nature", "--impala")]
+    if any(a.startswith("--") for a in args):
+        sys.exit(usage)  # e.g. a mistyped --seed=1 must not become out_path
+    main(args[0] if args else None, fabric="--fabric" in argv,
+         torso=torso, seed=seed)
