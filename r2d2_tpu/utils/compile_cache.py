@@ -20,15 +20,50 @@ _DEFAULT = os.path.join(os.path.expanduser("~"), ".cache", "r2d2_tpu",
                         "xla_cache")
 
 
-def enable(path: str | None = None) -> str | None:
+def _configured_platform() -> str:
+    """The platform this process is configured for, WITHOUT initialising
+    the backend (jax.devices() on a tunneled accelerator can hang)."""
+    try:
+        import jax
+
+        plat = getattr(jax.config, "jax_platforms", None)
+        if plat:
+            return plat.split(",")[0]
+    except Exception:
+        pass
+    env = os.environ.get("JAX_PLATFORMS", "")
+    return env.split(",")[0] if env else ""
+
+
+def enable(path: str | None = None, force: bool = False) -> str | None:
     """Enable the persistent compilation cache; returns the dir or None.
+
+    **Not by default on explicitly CPU-pinned processes**: measured on
+    this image, XLA:CPU persists AOT results keyed loosely enough that a
+    cached executable can reload under *mismatched host machine
+    features* ("could lead to execution errors such as SIGILL") and run
+    pathologically slowly — a cached actor act-fn degraded ~30x and
+    starved the actor plane.  CPU compiles are cheap anyway; the cache's
+    purpose is the multi-second TPU train-step/super-step compiles.  An
+    unset platform (JAX auto-detection — typical real TPU hosts) keeps
+    the cache; an explicit ``path`` arg, a non-off ``R2D2_COMPILE_CACHE``
+    value, or ``force=True`` opts in even on CPU.
 
     Precedence: explicit ``path`` arg > ``R2D2_COMPILE_CACHE`` env (``0``/
     ``off`` disables) > default under ``~/.cache/r2d2_tpu``.  Entries
-    below 1 s compile time are not persisted (cache stays small; only the
-    multi-second train-step/super-step graphs matter).
+    below 1 s compile time are not persisted (cache stays small).
     """
     env = os.environ.get("R2D2_COMPILE_CACHE", "")
+    env_is_path = bool(env) and env.lower() not in ("0", "off", "false")
+    # Gate applies only to *explicitly* CPU-configured processes (tests,
+    # the CPU tools — all of which pin jax_platforms="cpu" before calling
+    # this) with no explicit opt-in.  An unset platform means JAX
+    # auto-detection, typical on real TPU hosts — those must keep the
+    # cache.  A caller-provided path or a non-off R2D2_COMPILE_CACHE
+    # value is an explicit opt-in and bypasses the gate.
+    if (not force and path is None and not env_is_path
+            and _configured_platform() == "cpu"):
+        return None
     if path is None and env.lower() in ("0", "off", "false"):
         return None  # env off-switch governs only when no explicit path
     cache_dir = path or env or _DEFAULT

@@ -172,13 +172,20 @@ def test_compile_cache_enable_and_disable(tmp_path, monkeypatch):
     try:
         d = str(tmp_path / "xla")
         monkeypatch.delenv("R2D2_COMPILE_CACHE", raising=False)
+        # explicitly-CPU-pinned processes (this test session) must NOT
+        # enable the cache by default: XLA:CPU AOT reloads can mismatch
+        # host machine features (measured ~30x act-fn degradation +
+        # SIGILL risk)
+        assert compile_cache.enable() is None
+        # ...but an explicit path is an opt-in that bypasses the gate
         assert compile_cache.enable(d) == d
         assert os.path.isdir(d)
         assert jax.config.jax_compilation_cache_dir == d
 
         monkeypatch.setenv("R2D2_COMPILE_CACHE", "0")
-        assert compile_cache.enable() is None
+        assert compile_cache.enable(force=True) is None
 
+        # a non-off env value is also an explicit opt-in on CPU
         monkeypatch.setenv("R2D2_COMPILE_CACHE", str(tmp_path / "env_xla"))
         assert compile_cache.enable() == str(tmp_path / "env_xla")
 
