@@ -1,7 +1,17 @@
-"""Repo-root benchmark shim for the driver: delegates to r2d2_tpu.bench."""
+"""Repo-root benchmark shim for the driver: delegates to r2d2_tpu.bench.
+
+Script runs use the phase-isolated path (each phase in its own bounded
+subprocess, so a wedged tunnel claim times out instead of hanging the
+driver with no artifact); importing ``main`` keeps the in-process path.
+"""
 import sys
 
-from r2d2_tpu.bench import main, make_batch  # noqa: F401
+from r2d2_tpu.bench import _main_isolated, main, make_batch  # noqa: F401
 
 if __name__ == "__main__":
-    main(steps=int(sys.argv[1]) if len(sys.argv) > 1 else 100)
+    if "--phase" in sys.argv[1:]:
+        from r2d2_tpu.bench import _phase_main
+
+        sys.exit(_phase_main(sys.argv[1:]))
+    _main_isolated(steps=int(sys.argv[1]) if len(sys.argv) > 1 else 100,
+                   warmup=5, system_seconds=75.0)

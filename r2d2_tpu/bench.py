@@ -46,8 +46,15 @@ _PEAK_TFLOPS = (
 )
 
 
-def _peak_tflops(device) -> float:
-    kind = getattr(device, "device_kind", "")
+# the flagship system-bench cell (the learning presets' knobs — k=4 after
+# the CURVES_AB_PIPELINE_r04 lag A/B); shared by both bench entry paths so
+# script-mode and import-mode always measure the same fabric
+FLAGSHIP_SYSTEM_KNOBS = dict(device_replay=True, superstep_k=4,
+                             superstep_pipeline=2, num_actors=64,
+                             env_workers=0)
+
+
+def _peak_tflops(kind: str) -> float:
     for prefix, peak in _PEAK_TFLOPS:
         if kind.startswith(prefix):
             return peak
@@ -267,41 +274,184 @@ def _device_probe(timeout_s: float = 240.0):
         return False, f"device probe error: {type(e).__name__}: {e}"
 
 
+def _run_phase(phase: str, timeout_s: float, extra=()):
+    """Run one bench phase as a bounded subprocess; (result_dict, reason).
+
+    Each phase holds its own backend claim and releases it on clean exit;
+    a wedged phase (the k=16 tune cell of round 4 sat >20 min at zero CPU
+    in an uninterruptible device call) is killed at ``timeout_s`` and
+    reported, instead of hanging the driver's whole bench run with no
+    artifact.  Phases run strictly one at a time — the tunneled backend
+    hands the chip claim between processes."""
+    import subprocess
+
+    cmd = [sys.executable, "-m", "r2d2_tpu.bench", "--phase", phase,
+           *map(str, extra)]
+    # the package is run from a source tree, not installed: the child can
+    # only import r2d2_tpu with the repo root as cwd, wherever the parent
+    # was launched from
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, cwd=repo_root)
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.communicate(timeout=10.0)  # bounded reap (see
+            except Exception:                   # _device_probe)
+                pass
+            return None, (f"{phase} phase wedged (no result after "
+                          f"{timeout_s:.0f}s; child killed)")
+    except Exception as e:
+        return None, f"{phase} phase spawn error: {type(e).__name__}: {e}"
+    tail = (err or b"").decode(errors="replace").strip().splitlines()
+    if proc.returncode != 0:
+        return None, (f"{phase} phase failed (rc={proc.returncode}): "
+                      + " | ".join(tail[-3:]))
+    for line in reversed((out or b"").decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), ""
+            except Exception:
+                break
+    return None, f"{phase} phase emitted no JSON: " + " | ".join(tail[-3:])
+
+
+def _phase_main(argv) -> int:
+    """Child entry for one isolated phase; prints ONE JSON line."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--phase", required=True,
+                   choices=("micro", "actor", "system"))
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--seconds", type=float, default=75.0)
+    p.add_argument("--knobs", type=str, default="{}")
+    a = p.parse_args(argv)
+
+    from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
+
+    enable_compile_cache()
+    if a.phase == "micro":
+        import jax
+
+        fps, sps, flops = _learner_micro_bench(a.steps, a.warmup)
+        d = jax.devices()[0]
+        out = dict(learner_fps=fps, steps_per_sec=sps, flops=flops,
+                   platform=d.platform,
+                   device_kind=getattr(d, "device_kind", "?"))
+    elif a.phase == "actor":
+        out = dict(actor_fps=_actor_plane_bench())
+    else:
+        fps, spans, ups = _system_bench(a.seconds, **json.loads(a.knobs))
+        out = dict(system_fps=fps, top_spans=spans, updates=ups)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _main_isolated(steps: int, warmup: int, system_seconds: float) -> None:
+    """Driver-facing bench: every phase in its own bounded subprocess.
+
+    Ordering is by evidential value: the headline learner micro first (a
+    later wedge can no longer zero it), then the system fabric, then the
+    actor plane.  The parent composes the same one-line JSON as the
+    in-process path and never initializes a backend itself."""
+    ok, reason = _device_probe()
+    if not ok:
+        _print_unreachable_artifact(reason)
+        sys.exit(1)
+
+    system_knobs = dict(FLAGSHIP_SYSTEM_KNOBS)
+    # compile slack + 1 s/step: a deliberately long `bench.py 20000` run
+    # must not be misreported as a wedge
+    micro, m_err = _run_phase("micro", 900.0 + (steps + warmup) * 1.0,
+                              ("--steps", steps, "--warmup", warmup))
+    system, s_err = _run_phase(
+        "system", system_seconds + 900.0,
+        ("--seconds", system_seconds, "--knobs", json.dumps(system_knobs)))
+    actor, a_err = _run_phase("actor", 600.0)
+
+    result = {
+        "metric": "learner_env_frames_per_sec",
+        "value": round(micro["learner_fps"], 1) if micro else -1.0,
+        "unit": "frames/s",
+        "vs_baseline": (round(micro["learner_fps"] / NORTH_STAR_FPS, 3)
+                        if micro else -1.0),
+        "system_env_frames_per_sec": (round(system["system_fps"], 1)
+                                      if system else -1.0),
+        "system_vs_baseline": (round(system["system_fps"] / NORTH_STAR_FPS,
+                                     3) if system else -1.0),
+        "system_knobs": system_knobs,
+        "actor_env_frames_per_sec": (round(actor["actor_fps"], 1)
+                                     if actor else -1.0),
+        "host_cpus": os.cpu_count() or 0,
+    }
+    errors = {k: v for k, v in (("micro", m_err), ("system", s_err),
+                                ("actor", a_err)) if v}
+    if errors:
+        result["phase_errors"] = errors
+    if micro and micro.get("flops", 0) > 0:
+        achieved = micro["flops"] * micro["steps_per_sec"] / 1e12
+        result["achieved_tflops"] = round(achieved, 2)
+        peak = _peak_tflops(micro.get("device_kind", ""))
+        if peak > 0:
+            result["mfu"] = round(achieved / peak, 4)
+    print(json.dumps(result))
+    if micro:
+        print(f"# platform={micro.get('platform')} "
+              f"kind={micro.get('device_kind')} "
+              f"learner_steps/s={micro['steps_per_sec']:.2f} "
+              f"flops/step={micro['flops']:.3e} "
+              f"system_updates={system['updates'] if system else -1} "
+              "busiest_spans_total_ms="
+              f"{json.dumps(system['top_spans'] if system else {})}",
+              file=sys.stderr)
+    if not micro:
+        sys.exit(1)
+
+
+def _print_unreachable_artifact(reason: str) -> None:
+    artifact = {
+        "metric": "learner_env_frames_per_sec",
+        "value": -1.0, "unit": "frames/s", "vs_baseline": -1.0,
+        "error": f"accelerator backend unreachable ({reason})",
+    }
+    # attach the CURRENT probe run's history (tools/probe_then_measure
+    # writes one JSON line per bounded probe attempt) so an outage
+    # artifact also documents how long the backend has been down.  The
+    # status file is append-only across runs; attempt numbering
+    # restarts at 1 per run, so slice from the last attempt==1.
+    try:
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(here, "tools", "probe_status.jsonl")) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        attempts = [e for e in lines if "attempt" in e]
+        starts = [i for i, e in enumerate(attempts)
+                  if e.get("attempt") == 1]
+        if starts:
+            attempts = attempts[starts[-1]:]
+        if attempts:
+            artifact["probe_attempts"] = len(attempts)
+            artifact["probed_from_to"] = (attempts[0].get("t"),
+                                          attempts[-1].get("t"))
+            artifact["any_probe_succeeded"] = any(e.get("ok")
+                                                  for e in attempts)
+    except Exception:
+        pass
+    print(json.dumps(artifact))
+
+
 def main(steps: int = 100, warmup: int = 5,
          system_seconds: float = 75.0) -> None:
     import traceback
 
     ok, reason = _device_probe()
     if not ok:
-        artifact = {
-            "metric": "learner_env_frames_per_sec",
-            "value": -1.0, "unit": "frames/s", "vs_baseline": -1.0,
-            "error": f"accelerator backend unreachable ({reason})",
-        }
-        # attach the CURRENT probe run's history (tools/probe_then_measure
-        # writes one JSON line per bounded probe attempt) so an outage
-        # artifact also documents how long the backend has been down.  The
-        # status file is append-only across runs; attempt numbering
-        # restarts at 1 per run, so slice from the last attempt==1.
-        try:
-            here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-            with open(os.path.join(here, "tools",
-                                   "probe_status.jsonl")) as f:
-                lines = [json.loads(ln) for ln in f if ln.strip()]
-            attempts = [e for e in lines if "attempt" in e]
-            starts = [i for i, e in enumerate(attempts)
-                      if e.get("attempt") == 1]
-            if starts:
-                attempts = attempts[starts[-1]:]
-            if attempts:
-                artifact["probe_attempts"] = len(attempts)
-                artifact["probed_from_to"] = (attempts[0].get("t"),
-                                              attempts[-1].get("t"))
-                artifact["any_probe_succeeded"] = any(e.get("ok")
-                                                      for e in attempts)
-        except Exception:
-            pass
-        print(json.dumps(artifact))
+        _print_unreachable_artifact(reason)
         sys.exit(1)
 
     from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
@@ -321,8 +471,7 @@ def main(steps: int = 100, warmup: int = 5,
     except Exception:
         traceback.print_exc()
         actor_fps = -1.0
-    system_knobs = dict(device_replay=True, superstep_k=4,
-                        superstep_pipeline=2, num_actors=64, env_workers=0)
+    system_knobs = dict(FLAGSHIP_SYSTEM_KNOBS)
     try:
         system_fps, top_spans, sys_updates = _system_bench(system_seconds,
                                                            **system_knobs)
@@ -349,7 +498,7 @@ def main(steps: int = 100, warmup: int = 5,
     if flops > 0:
         achieved = flops * steps_per_sec / 1e12
         result["achieved_tflops"] = round(achieved, 2)
-        peak = _peak_tflops(dev)
+        peak = _peak_tflops(getattr(dev, "device_kind", ""))
         if peak > 0:
             result["mfu"] = round(achieved / peak, 4)
     print(json.dumps(result))
@@ -361,4 +510,8 @@ def main(steps: int = 100, warmup: int = 5,
 
 
 if __name__ == "__main__":
-    main(steps=int(sys.argv[1]) if len(sys.argv) > 1 else 100)
+    if "--phase" in sys.argv[1:]:
+        sys.exit(_phase_main(sys.argv[1:]))
+    _main_isolated(
+        steps=int(sys.argv[1]) if len(sys.argv) > 1 else 100,
+        warmup=5, system_seconds=75.0)

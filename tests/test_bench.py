@@ -66,6 +66,85 @@ def test_bench_reports_unreachable_device_as_artifact(monkeypatch, capsys):
     assert "unreachable" in result["error"]
 
 
+def test_isolated_bench_composes_phase_results(monkeypatch, capsys):
+    """Script-mode bench (phase-per-subprocess): a wedged system phase
+    must surface as -1 + phase_errors while the already-banked micro
+    headline survives, matching the in-process failure isolation."""
+    from r2d2_tpu import bench
+
+    monkeypatch.setattr(bench, "_device_probe", lambda *a, **k: (True, ""))
+
+    def fake_run_phase(phase, timeout_s, extra=()):
+        if phase == "micro":
+            return (dict(learner_fps=100000.0, steps_per_sec=40.0,
+                         flops=2e9, platform="tpu",
+                         device_kind="TPU v5 lite"), "")
+        if phase == "system":
+            return None, "system phase wedged (no result after 975s; " \
+                         "child killed)"
+        return dict(actor_fps=2400.0), ""
+
+    monkeypatch.setattr(bench, "_run_phase", fake_run_phase)
+    bench._main_isolated(steps=1, warmup=0, system_seconds=0.1)
+    out = capsys.readouterr().out.strip().splitlines()
+    result = json.loads(out[0])
+    assert result["value"] == 100000.0
+    assert result["system_env_frames_per_sec"] == -1.0
+    assert "wedged" in result["phase_errors"]["system"]
+    assert result["actor_env_frames_per_sec"] == 2400.0
+    # MFU from the micro child's flops + device kind (v5e peak 197)
+    assert result["mfu"] == round(2e9 * 40.0 / 1e12 / 197.0, 4)
+
+
+def test_isolated_bench_headline_failure_exits_nonzero(monkeypatch, capsys):
+    from r2d2_tpu import bench
+
+    monkeypatch.setattr(bench, "_device_probe", lambda *a, **k: (True, ""))
+    monkeypatch.setattr(bench, "_run_phase",
+                        lambda phase, t, extra=(): (None, f"{phase} died"))
+    import pytest
+
+    with pytest.raises(SystemExit) as ex:
+        bench._main_isolated(steps=1, warmup=0, system_seconds=0.1)
+    assert ex.value.code == 1
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert result["value"] == -1.0
+    assert set(result["phase_errors"]) == {"micro", "system", "actor"}
+
+
+def test_run_phase_parses_last_json_line(monkeypatch):
+    """_run_phase must pick the child's JSON result even when warnings
+    or log lines surround it, and report rc!=0 / no-JSON as a reason."""
+    import subprocess
+
+    from r2d2_tpu import bench
+
+    class FakeProc:
+        def __init__(self, out, rc):
+            self._out, self.returncode = out, rc
+
+        def communicate(self, timeout=None):
+            return self._out.encode(), b"some warning\n"
+
+    def fake_popen(cmd, **kw):
+        assert "--phase" in cmd
+        return FakeProc('log line\n{"actor_fps": 7.0}\n', 0)
+
+    monkeypatch.setattr(subprocess, "Popen", fake_popen)
+    res, err = bench._run_phase("actor", 5.0)
+    assert res == {"actor_fps": 7.0} and err == ""
+
+    monkeypatch.setattr(subprocess, "Popen",
+                        lambda cmd, **kw: FakeProc("no json here\n", 0))
+    res, err = bench._run_phase("actor", 5.0)
+    assert res is None and "no JSON" in err
+
+    monkeypatch.setattr(subprocess, "Popen",
+                        lambda cmd, **kw: FakeProc("", 3))
+    res, err = bench._run_phase("actor", 5.0)
+    assert res is None and "rc=3" in err
+
+
 def test_actor_plane_bench_fleet_split_counts_all_lanes(monkeypatch):
     """The fleets/env_workers/act_device knobs (tools/actor_scaling.py's
     sweep surface) must keep the frames accounting exact: every lane lands

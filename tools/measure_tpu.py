@@ -256,14 +256,22 @@ def main(quick: bool = False) -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import tune_system
 
-    tune_system.main(seconds=60.0, grid=[
+    # inproc: this process already holds the exclusive chip claim (micro
+    # bench above) — a subprocess cell would deadlock against it.  The
+    # cost is that an in-process cell CAN wedge unboundedly (the round-4
+    # k=16 freeze); acceptable for this interactively-run battery, never
+    # for the driver-facing bench.py (which is fully phase-isolated).
+    # 120 s walls: round 4 showed 60 s cells are consumed by ramp + first
+    # compile of each k's superstep graph on a cold persistent cache.
+    tune_system.main(seconds=120.0, grid=[
         (True, 4, 64, 0, 2),    # the learning presets' cell (post
                                 # CURVES_AB_PIPELINE_r04 lag A/B)
         (True, 8, 64, 0, 2),
         (True, 16, 64, 0, 2),   # throughput-ceiling cells
         (True, 32, 64, 0, 2),
         (True, 4, 64, 0, 1),
-    ], out="measure_tpu_grid.json")  # never clobber a full sweep's JSON
+    ], out="measure_tpu_grid.json",  # never clobber a full sweep's JSON
+        inproc=True)
 
     # --- 5. actor plane ---
     from r2d2_tpu.bench import _actor_plane_bench

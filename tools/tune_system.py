@@ -19,7 +19,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from r2d2_tpu.bench import _system_bench  # noqa: E402
 
 GRID = [
     # (device_replay, superstep_k, num_actors, env_workers, pipeline)
@@ -35,21 +34,46 @@ GRID = [
 
 
 def main(seconds: float = 60.0, grid=None,
-         out: str = "tune_system_results.json") -> None:
+         out: str = "tune_system_results.json",
+         cell_timeout_slack: float = 900.0, inproc: bool = False) -> None:
+    """Each cell runs as a bounded subprocess via the bench phase CLI: a
+    cell wedged in an uninterruptible device call (observed round 4 —
+    k=16 sat >20 min at zero CPU and froze the whole in-process sweep)
+    costs ``seconds + cell_timeout_slack``, not the sweep.
+
+    ``inproc=True`` keeps the old same-process cells — required when the
+    caller already holds the (exclusive) chip claim, e.g. the
+    measure_tpu.py battery after its in-process micro bench; a subprocess
+    cell would deadlock against the parent's claim until timeout."""
+    from r2d2_tpu.bench import _run_phase, _system_bench
+
     print(f"{'replay':>7} {'k':>3} {'actors':>6} {'workers':>7} {'pipe':>4} "
           f"{'frames/s':>12} {'updates':>8}  busiest_span")
     results = []
     for device_replay, k, actors, workers, pipe in (GRID if grid is None
                                                     else grid):
-        try:
-            fps, top_spans, updates = _system_bench(
-                seconds, device_replay=device_replay, superstep_k=k,
-                num_actors=actors, env_workers=workers,
-                superstep_pipeline=pipe)
-        except Exception as e:  # keep sweeping; report the failure
+        knobs = dict(device_replay=device_replay, superstep_k=k,
+                     num_actors=actors, env_workers=workers,
+                     superstep_pipeline=pipe)
+        if inproc:
+            try:
+                fps, top_spans, updates = _system_bench(seconds, **knobs)
+            except Exception as e:
+                res, err = None, f"{type(e).__name__}: {e}"
+            else:
+                res, err = True, ""
+        else:
+            res, err = _run_phase(
+                "system", seconds + cell_timeout_slack,
+                ("--seconds", seconds, "--knobs", json.dumps(knobs)))
+            if res is not None:
+                fps, top_spans, updates = (res["system_fps"],
+                                           res["top_spans"],
+                                           res["updates"])
+        if res is None:  # keep sweeping; report the failure
             print(f"{'dev' if device_replay else 'host':>7} {k:>3} "
                   f"{actors:>6} {workers:>7} {pipe:>4} {'FAILED':>12} "
-                  f"{type(e).__name__}: {e}")
+                  f"{err}")
             continue
         top = next(iter(top_spans), "-")
         results.append(dict(device_replay=device_replay, superstep_k=k,
