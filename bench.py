@@ -6,12 +6,7 @@ driver with no artifact); importing ``main`` keeps the in-process path.
 """
 import sys
 
-from r2d2_tpu.bench import _main_isolated, main, make_batch  # noqa: F401
+from r2d2_tpu.bench import _script_main, main, make_batch  # noqa: F401
 
 if __name__ == "__main__":
-    if "--phase" in sys.argv[1:]:
-        from r2d2_tpu.bench import _phase_main
-
-        sys.exit(_phase_main(sys.argv[1:]))
-    _main_isolated(steps=int(sys.argv[1]) if len(sys.argv) > 1 else 100,
-                   warmup=5, system_seconds=75.0)
+    sys.exit(_script_main(sys.argv[1:]))

@@ -509,9 +509,16 @@ def main(steps: int = 100, warmup: int = 5,
           file=sys.stderr)
 
 
+def _script_main(argv) -> int:
+    """Shared script entry for `python bench.py`, `python -m r2d2_tpu.bench`,
+    and `r2d2 bench` — one place for the phase dispatch and the default
+    steps/warmup/system_seconds, so every entry measures the same thing."""
+    if "--phase" in argv:
+        return _phase_main(argv)
+    _main_isolated(steps=int(argv[0]) if argv else 100,
+                   warmup=5, system_seconds=75.0)
+    return 0
+
+
 if __name__ == "__main__":
-    if "--phase" in sys.argv[1:]:
-        sys.exit(_phase_main(sys.argv[1:]))
-    _main_isolated(
-        steps=int(sys.argv[1]) if len(sys.argv) > 1 else 100,
-        warmup=5, system_seconds=75.0)
+    sys.exit(_script_main(sys.argv[1:]))

@@ -149,8 +149,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "bench":
         from r2d2_tpu import bench
 
-        bench.main(steps=args.steps)
-        return 0
+        # phase-isolated path (same as `python bench.py`): a wedged
+        # tunnel claim times out per phase instead of hanging the CLI
+        return bench._script_main([str(args.steps)])
 
     try:
         cfg = build_config(args)

@@ -125,6 +125,13 @@ class Config:
     act_device: str = "auto"          # actor inference backend: "auto"
                                       # (CPU when the learner owns an
                                       # accelerator), "cpu", or "default"
+    fused_double_unroll: bool = False  # compute the online+target forwards
+                                      # as ONE unroll vmapped over stacked
+                                      # params: half the sequential LSTM
+                                      # chain at double per-step batch
+                                      # (learner/step.py:_double_unroll);
+                                      # off until measured faster on the
+                                      # target chip
     seed: int = 0
 
     # --- derived ----------------------------------------------------------

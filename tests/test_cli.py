@@ -92,3 +92,16 @@ def test_cli_eval_env_uses_noop_start(tmp_path, monkeypatch):
           "--episodes", "1"])
     assert seen and all(seen), "eval env built without noop_start=True"
 
+
+
+def test_cli_bench_routes_to_isolated_script_main(monkeypatch):
+    """`r2d2 bench` must go through the phase-isolated script path (a
+    wedged tunnel phase then times out bounded), not the in-process
+    bench.main()."""
+    from r2d2_tpu import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_script_main",
+                        lambda argv: calls.append(argv) or 0)
+    assert main(["bench", "--steps", "7"]) == 0
+    assert calls == [["7"]]

@@ -85,6 +85,37 @@ def test_sharded_step_matches_single_device():
                                    rtol=1e-4, atol=1e-6)
 
 
+def test_fused_double_unroll_sharded_matches_single_device():
+    """The fused online+target unroll (vmap over stacked params) must
+    survive GSPMD partitioning: dp=8 fused step == single-device fused
+    step == single-device unfused step."""
+    cfg = make_test_config(fused_double_unroll=True)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    batch = make_batch(cfg, np.random.default_rng(3))
+
+    s1, loss1, prio1 = jit_train_step(cfg, net)(
+        create_train_state(cfg, params),
+        jax.tree.map(jax.numpy.asarray, batch))
+    s0, loss0, _ = jit_train_step(cfg.replace(fused_double_unroll=False),
+                                  net)(create_train_state(cfg, params),
+                                       jax.tree.map(jax.numpy.asarray,
+                                                    batch))
+    assert float(loss0) == pytest.approx(float(loss1), rel=1e-5)
+
+    mesh = make_mesh(cfg)
+    sN, lossN, prioN = sharded_train_step(cfg, net, mesh)(
+        replicate_state(mesh, create_train_state(cfg, params)),
+        shard_batch(mesh, batch))
+    assert float(loss1) == pytest.approx(float(lossN), rel=1e-5)
+    np.testing.assert_allclose(np.asarray(prio1), np.asarray(prioN),
+                               rtol=1e-4, atol=1e-6)
+    for p1, pN in zip(jax.tree.leaves(s1.params),
+                      jax.tree.leaves(sN.params)):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(pN),
+                                   rtol=1e-4, atol=1e-6)
+
+
 def test_sharded_multistep_stays_in_sync():
     """Run 3 sharded steps (with in-graph target sync crossing its cadence)
     and compare against 3 single-device steps."""
