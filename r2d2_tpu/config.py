@@ -125,6 +125,16 @@ class Config:
     act_device: str = "auto"          # actor inference backend: "auto"
                                       # (CPU when the learner owns an
                                       # accelerator), "cpu", or "default"
+    in_graph_per: bool = False        # device-resident PER: prioritized
+                                      # sampling, IS weights, AND priority
+                                      # feedback run INSIDE the super-step
+                                      # (learner/step.py), so the learner
+                                      # needs zero host round trips per
+                                      # dispatch and the k inner steps see
+                                      # fresh priorities (the host path's
+                                      # feedback lags >= k updates).
+                                      # Requires device_replay, replicated
+                                      # ring layout
     fused_double_unroll: bool = False  # compute the online+target forwards
                                       # as ONE unroll vmapped over stacked
                                       # params: half the sequential LSTM
@@ -192,6 +202,12 @@ class Config:
             raise ValueError("superstep_k must be >= 1")
         if self.superstep_pipeline < 0:
             raise ValueError("superstep_pipeline must be >= 0")
+        if self.in_graph_per and not self.device_replay:
+            raise ValueError("in_graph_per requires device_replay=True "
+                             "(sampling reads the HBM-resident ring)")
+        if self.in_graph_per and self.device_ring_layout == "dp":
+            raise ValueError("in_graph_per requires a replicated ring "
+                             "layout (dp slabs sample on the host)")
         if self.device_ring_layout not in ("auto", "replicated", "dp"):
             raise ValueError(
                 f"unknown device_ring_layout {self.device_ring_layout!r}")

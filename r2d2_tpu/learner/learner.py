@@ -355,6 +355,13 @@ class Learner:
         the per-host device shards with zero data movement.
         """
         cfg = self.cfg
+        if cfg.in_graph_per and jax.process_count() > 1:
+            # fail HERE, not deep in sample_meta on an empty sum tree
+            # (in-graph mode never populates the host tree)
+            raise NotImplementedError(
+                "in_graph_per is single-process for now — multi-host "
+                "device replay samples per-host slabs through the host "
+                "tree (use in_graph_per=False)")
         if jax.process_count() > 1:
             return self._run_device_multihost(buffer, ring, priority_sink,
                                               max_steps, stop, tracer)
@@ -367,6 +374,9 @@ class Learner:
         t0 = time.time()
         updates = self.num_updates
         target = cfg.training_steps if max_steps is None else updates + max_steps
+        if cfg.in_graph_per:
+            return self._run_device_in_graph_per(buffer, ring, k, target,
+                                                 t0, stop, tracer)
         # AOT-compile outside the buffer lock: the first dispatch happens
         # under it (sample_meta couples sampling + dispatch), and tracing a
         # fresh jit there would stall actor add()s for the whole compile
@@ -431,6 +441,101 @@ class Learner:
         def sample():
             with tracer.span("learner.sample_meta"):
                 return buffer.sample_meta(k, dispatch=dispatch)
+
+        self._superstep_loop(k, target, t0, gate, sample, harvest,
+                             prepare=prepare)
+
+        if self.checkpointer is not None:
+            self._save(self.num_updates, t0)
+        mins = self.start_minutes + (time.time() - t0) / 60.0
+        return dict(
+            num_updates=self.num_updates,
+            env_steps=self.env_steps,
+            minutes=mins,
+            mean_loss=(float(np.mean(losses_hist))
+                       if losses_hist else float("nan")),
+        )
+
+    def _run_device_in_graph_per(self, buffer, ring, k: int, target: int,
+                                 t0: float, stop, tracer
+                                 ) -> Dict[str, float]:
+        """Device-PER drivetrain (``cfg.in_graph_per``): sampling, IS
+        weights, and priority feedback all execute inside the super-step
+        (learner/step.py:make_in_graph_per_super_step), so each dispatch
+        is ONE H2D scalar (the seed) and ONE small D2H (the losses, for
+        logging) — the ``learner.result_sync`` priority round trip of
+        :meth:`run_device` (~99 ms/harvest on the tunneled chip,
+        MEASURE_TPU_r04.md) leaves the training path entirely, and the k
+        inner steps sample from priorities the previous inner step wrote
+        (tighter feedback than the reference's 8+4-batch queue lag,
+        worker.py:300-316).
+
+        The priorities array is a donated carry: the dispatch consumes
+        the ring's current handle and the returned one is stored back
+        before the buffer lock is released, so actor block commits
+        (``DeviceRing.commit_per``, same lock) always target the newest
+        generation.  Single-process only for now (a mesh run would need
+        the sharded-super-step treatment of parallel/mesh.py)."""
+        cfg = self.cfg
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "in_graph_per under a mesh is not yet supported — use the "
+                "host-sampled device-replay path (in_graph_per=False)")
+        from r2d2_tpu.learner.step import make_in_graph_per_super_step
+
+        super_fn = make_in_graph_per_super_step(cfg, self.net, k)
+        meta_h = ring.per_meta()
+        seed0 = jnp.asarray(0, jnp.uint32)
+        try:
+            super_fn = super_fn.lower(
+                self.state, ring.snapshot(), ring.take_prios(),
+                meta_h["seq_meta"], meta_h["first"], seed0).compile()
+        except Exception:
+            pass  # no AOT API: the jit wrapper compiles at first call
+        compiled = super_fn
+        losses_hist: deque = deque(maxlen=100)
+        dispatch_no = [0]
+
+        def gate() -> str:
+            if stop is not None and stop():
+                return "break"
+            return "go" if buffer.ready else "wait"
+
+        def sample():
+            with tracer.span("learner.step_dispatch"):
+                with buffer.lock:
+                    # fold_in(PRNGKey(cfg.seed), idx) happens in-graph;
+                    # the u32 counter wraps harmlessly after 2^32
+                    idx = jnp.asarray(
+                        dispatch_no[0] & 0xFFFFFFFF, jnp.uint32)
+                    dispatch_no[0] += 1
+                    meta = ring.per_meta()
+                    st, new_prios, losses = compiled(
+                        self.state, ring.snapshot(), ring.take_prios(),
+                        meta["seq_meta"], meta["first"], idx)
+                    ring.put_prios(new_prios)
+                    env_steps = buffer.env_steps
+            # losses ride the pipeline; priorities never leave the device
+            return dict(dispatched=(st, losses, None),
+                        env_steps=env_steps)
+
+        def prepare(item):
+            meta, losses, _ = item
+            try:
+                losses.copy_to_host_async()
+            except Exception:
+                pass  # prefetch failure: harvest pays the round trip
+            return (meta, losses)
+
+        def harvest(item) -> None:
+            meta, losses = item
+            with tracer.span("learner.result_sync"):
+                losses_np = np.asarray(jax.device_get(losses))
+            assert np.isfinite(losses_np).all(), (
+                f"non-finite loss in super-step: {losses_np}")
+            self.env_steps = int(meta["env_steps"])
+            buffer.note_updates(losses_np.shape[0], losses_np.sum())
+            losses_hist.extend(losses_np.tolist())
 
         self._superstep_loop(k, target, t0, gate, sample, harvest,
                              prepare=prepare)

@@ -255,3 +255,84 @@ def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
 
 def make_super_step(cfg: Config, net: R2D2Network, k: int):
     return jax.jit(make_super_step_fn(cfg, net, k), donate_argnums=(0,))
+
+
+def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
+    """One prioritized batch draw on-device: (idx (B,), is_weights (B,)
+    f32, ints (B, 6) i32).
+
+    Proportional sampling via ``jax.random.categorical`` over
+    log-priorities (B independent draws == the host sum-tree's
+    ``sample``, replay/sum_tree.py); zero-priority leaves (empty slots,
+    block padding) get -inf logits and are unsampleable.  IS weights are
+    the reference scheme on exact densities: w = (q/min q)^-beta with
+    q = p_i/sum p.  The ints bundle reproduces ``sample_meta``'s index
+    arithmetic (replay_buffer.py:372-390) from the device-resident
+    metadata, so ``gather_batch`` sees identical inputs either way."""
+    K, L = cfg.seqs_per_block, cfg.learning_steps
+    B = cfg.batch_size
+    logits = jnp.where(prios > 0, jnp.log(prios), -jnp.inf)
+    idx = jax.random.categorical(key, logits, shape=(B,))
+    block_idx = idx // K
+    seq_idx = (idx % K).astype(jnp.int32)
+    meta = seq_meta[block_idx, seq_idx]                         # (B, 3)
+    burn = meta[:, 0]
+    start = first_burn[block_idx] + seq_idx * L
+    ints_t = jnp.stack(
+        [block_idx.astype(jnp.int32), start - burn, seq_idx, burn,
+         meta[:, 1], meta[:, 2]], axis=1)
+    q = prios[idx] / prios.sum()
+    w = (q / q.min()) ** (-cfg.importance_sampling_exponent)
+    return idx, w.astype(jnp.float32), ints_t
+
+
+def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int):
+    """``k`` fused steps with DEVICE-side PER: sample → gather → step →
+    priority scatter, all inside one dispatch.
+
+    vs :func:`make_super_step_fn` (host-sampled bundles): the learner
+    loop no longer round-trips priorities through the host at all — on a
+    high-latency interconnect (the tunneled chip measures ~100 ms/RTT,
+    MEASURE_TPU_r04.md: ``learner.result_sync`` ≈ 99 ms/harvest) the
+    dispatch cadence becomes pure device compute.  It is also *tighter*
+    feedback than the reference's queue (worker.py:300-316 lags 8+4
+    batches) or our host path (lags ≥ k): step j+1 samples from the
+    priorities step j just wrote.
+
+    Signature: ``super_step(state, ring_arrays, prios (NB*K,) f32
+    [donated], seq_meta (NB,K,3) i32, first_burn (NB,) i32,
+    dispatch_idx u32) -> (state, prios', losses (k,))``.  The sampling
+    stream is ``fold_in(PRNGKey(cfg.seed), dispatch_idx)`` — distinct per
+    dispatch with no seed/counter bit-packing to alias or overflow.
+    """
+    from r2d2_tpu.replay.device_ring import gather_batch
+
+    step = make_train_step(cfg, net)
+
+    def super_step(state: TrainState, arrays, prios, seq_meta, first_burn,
+                   dispatch_idx):
+        keys = jax.random.split(
+            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), dispatch_idx),
+            k)
+
+        def body(carry, key_t):
+            st, p = carry
+            idx, w, ints_t = _in_graph_sample(cfg, key_t, p, seq_meta,
+                                              first_burn)
+            batch = gather_batch(cfg, arrays, ints_t, w)
+            st, loss, new_p = step(st, batch)
+            # feedback: same exponentiation the host tree applies
+            # (sum_tree.py:60); duplicate-idx writes resolve arbitrarily,
+            # as does the host's sequential last-wins — both harmless
+            p = p.at[idx].set(new_p ** cfg.prio_exponent)
+            return (st, p), loss
+
+        (state, prios), losses = jax.lax.scan(body, (state, prios), keys)
+        return state, prios, losses
+
+    return super_step
+
+
+def make_in_graph_per_super_step(cfg: Config, net: R2D2Network, k: int):
+    return jax.jit(make_in_graph_per_super_step_fn(cfg, net, k),
+                   donate_argnums=(0, 2))

@@ -189,6 +189,20 @@ def resolve_layout(cfg: Config, mesh, need_bytes: int,
     return "replicated"
 
 
+def _write_per_fn(prios: jnp.ndarray, seq_meta: jnp.ndarray,
+                  first_burn: jnp.ndarray, prios_slot: jnp.ndarray,
+                  meta_slot: jnp.ndarray, first_val: jnp.ndarray,
+                  slot: jnp.ndarray, K: int):
+    """Donated in-place write of one block's PER leaves + sampling
+    metadata (in-graph-PER mode, see :class:`DeviceRing`)."""
+    prios = jax.lax.dynamic_update_slice(prios, prios_slot, (slot * K,))
+    seq_meta = jax.lax.dynamic_update_index_in_dim(seq_meta, meta_slot,
+                                                   slot, 0)
+    first_burn = jax.lax.dynamic_update_index_in_dim(
+        first_burn, first_val, slot, 0)
+    return prios, seq_meta, first_burn
+
+
 class DeviceRing:
     """Owns the device-resident ring arrays and their write path.
 
@@ -235,6 +249,32 @@ class DeviceRing:
         self.arrays = {
             k: self._put(np.zeros((NB, *shape), dtype))
             for k, (shape, dtype) in self._slot_shapes.items()}
+
+        # --- in-graph PER state (cfg.in_graph_per) ---------------------
+        # Leaf priorities (td**alpha; 0 = never-sampleable) plus the
+        # per-sequence window metadata the in-graph sampler needs to
+        # build index bundles without the host (learner/step.py
+        # _in_graph_sample).  Replicated under a mesh (tiny arrays).
+        # The priorities handle is READ-WRITE from the learner's super
+        # step (donated carry) AND written by actor block commits —
+        # both sides mutate it only under the module's coordinating
+        # lock, via take_prios()/put_prios() and commit_per().
+        self._per_write = None
+        if getattr(cfg, "in_graph_per", False):
+            if self.num_groups > 1:
+                raise ValueError(
+                    "in_graph_per currently requires a replicated ring "
+                    "(device_ring_layout='dp' samples per group slab on "
+                    "the host)")
+            K = cfg.seqs_per_block
+            self._per_prios = self._put_slot(
+                np.zeros((NB * K,), np.float32))
+            self._per_seq_meta = self._put_slot(
+                np.zeros((NB, K, 3), np.int32))
+            self._per_first = self._put_slot(np.zeros((NB,), np.int32))
+            self._per_write = jax.jit(
+                functools.partial(_write_per_fn, K=K),
+                donate_argnums=(0, 1, 2))
 
     def _put(self, x):
         return (jax.device_put(x, self._placement)
@@ -288,3 +328,31 @@ class DeviceRing:
         """Current ring handles, safe to pass to a train-step dispatch
         (caller holds the coordinating lock — see the module contract)."""
         return self.arrays
+
+    # ------------------------------------------------- in-graph PER state
+    def commit_per(self, slot: int, prios_alpha: np.ndarray,
+                   meta: np.ndarray, first_burn: int) -> None:
+        """Write one block's PER leaves (td**alpha, (K,) f32, zero-padded
+        past num_sequences = unsampleable) + sampling metadata ((K, 3)
+        i32 [burn, learn, fwd]; first_burn scalar).  Caller holds the
+        coordinating lock."""
+        self._per_prios, self._per_seq_meta, self._per_first = (
+            self._per_write(
+                self._per_prios, self._per_seq_meta, self._per_first,
+                jnp.asarray(prios_alpha, jnp.float32),
+                jnp.asarray(meta, jnp.int32),
+                jnp.asarray(first_burn, jnp.int32),
+                jnp.asarray(slot, jnp.int32)))
+
+    def take_prios(self) -> jnp.ndarray:
+        """The current priorities handle, for a super-step dispatch that
+        DONATES it (the dispatch's returned handle must be stored back
+        with :meth:`put_prios` before the lock is released)."""
+        return self._per_prios
+
+    def put_prios(self, handle: jnp.ndarray) -> None:
+        self._per_prios = handle
+
+    def per_meta(self) -> Dict[str, jnp.ndarray]:
+        """Read-only sampling metadata handles for a dispatch."""
+        return dict(seq_meta=self._per_seq_meta, first=self._per_first)

@@ -201,13 +201,33 @@ class ReplayBuffer:
         # provides.
         staged = (self.device_ring.stage(block)
                   if self.device_ring is not None else None)
+        in_graph = getattr(cfg, "in_graph_per", False)
+        if in_graph:
+            # device-PER leaves: td**alpha — ``priorities`` arrives
+            # K-length zero-padded past the block's real sequences
+            # (block.py:108), and 0**alpha keeps the padding zero ==
+            # unsampleable for the in-graph categorical; the metadata
+            # bundle is per real sequence (k_seq-length)
+            k_seq = block.num_sequences
+            prios_alpha = (np.asarray(priorities, np.float64)
+                           ** cfg.prio_exponent).astype(np.float32)
+            meta = np.zeros((K, 3), np.int32)
+            meta[:k_seq, 0] = block.burn_in_steps
+            meta[:k_seq, 1] = block.learning_steps
+            meta[:k_seq, 2] = block.forward_steps
         with self.lock:
             ptr = self.block_ptr
             # every array (and the PER leaves) is keyed by the PHYSICAL
             # slot; the logical ptr only orders the FIFO walk
             slot = self._phys_block(ptr)
-            leaf_idxes = np.arange(slot * K, (slot + 1) * K, dtype=np.int64)
-            self.tree.update(leaf_idxes, priorities)
+            if in_graph:
+                # priorities live on-device; the host tree stays empty
+                self.device_ring.commit_per(slot, prios_alpha, meta,
+                                            int(block.burn_in_steps[0]))
+            else:
+                leaf_idxes = np.arange(slot * K, (slot + 1) * K,
+                                       dtype=np.int64)
+                self.tree.update(leaf_idxes, priorities)
 
             self.size -= int(self.block_learning_total[slot])
 
@@ -447,6 +467,15 @@ class ReplayBuffer:
             self.tree.update(idxes[mask], priorities[mask])
             self.training_steps += 1
             self.sum_loss += float(loss)
+
+    def note_updates(self, n: int, loss_sum: float) -> None:
+        """Learner-side update accounting when priority feedback never
+        crosses the host (``cfg.in_graph_per`` — the scatter happens
+        inside the super-step), so the log plane's ``stats()`` counters
+        stay live without :meth:`update_priorities`."""
+        with self.lock:
+            self.training_steps += n
+            self.sum_loss += float(loss_sum)
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> Dict[str, float]:

@@ -387,6 +387,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         # device replay: the learner samples index bundles itself (cheap,
         # coupled to its dispatch) — no host batch-staging thread
         loops = [(n, f) for n, f in loops if n != "sample"]
+    if cfg.in_graph_per:
+        # priority feedback never crosses the host (the super-step
+        # scatters it on-device) — nothing would ever feed this queue
+        loops = [(n, f) for n, f in loops if n != "priority"]
     for name, loop in loops:
         supervisor.start(name, loop)
 

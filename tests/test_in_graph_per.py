@@ -1,0 +1,198 @@
+"""Device-resident PER (cfg.in_graph_per): sampling, IS weights, and
+priority feedback inside the super-step.
+
+Covers the redesign of the reference's host-side sum-tree feedback loop
+(worker.py:242-276 update, worker.py:300-316 staging lag): the sampling
+distribution and index arithmetic must match the host path exactly, the
+in-graph scatter must only touch sampled leaves, and the full fabric must
+run with zero host priority traffic.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.learner.step import (
+    _in_graph_sample, create_train_state, make_in_graph_per_super_step,
+)
+from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.replay.block import LocalBuffer
+from r2d2_tpu.replay.device_ring import DeviceRing
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.envs.fake import FakeAtariEnv
+
+A = 4
+
+
+def make_cfg(**kw):
+    return make_test_config(device_replay=True, in_graph_per=True, **kw)
+
+
+def scripted_blocks(cfg, n_blocks, seed=0):
+    rng = np.random.default_rng(seed)
+    local = LocalBuffer(cfg, A)
+    out = []
+    obs = rng.integers(0, 256, cfg.stored_obs_shape, np.uint8)
+    local.reset(obs)
+    while len(out) < n_blocks:
+        for _ in range(cfg.block_length):
+            obs = rng.integers(0, 256, cfg.stored_obs_shape, np.uint8)
+            q = rng.normal(size=A).astype(np.float32)
+            hidden = rng.normal(size=(2, cfg.lstm_layers,
+                                      cfg.hidden_dim)).astype(np.float32)
+            local.add(int(rng.integers(A)), float(rng.normal()), obs, q,
+                      hidden)
+        blk, prios, _ = local.finish(rng.normal(size=A).astype(np.float32))
+        out.append((blk, prios))
+    return out
+
+
+def filled(cfg, n_blocks=4, seed=0):
+    ring = DeviceRing(cfg, A)
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(99),
+                       device_ring=ring)
+    for blk, prios in scripted_blocks(cfg, n_blocks, seed):
+        buf.add(blk, prios, None)
+    return buf, ring
+
+
+def test_per_leaves_mirror_host_tree_values():
+    """commit_per must store exactly what the host tree would: td**alpha
+    at the block's real sequences, zero (unsampleable) past them."""
+    cfg = make_cfg()
+    K = cfg.seqs_per_block
+    buf, ring = filled(cfg, n_blocks=3)
+    host = ReplayBuffer(cfg.replace(in_graph_per=False, device_replay=False),
+                        A, rng=np.random.default_rng(99))
+    for blk, prios in scripted_blocks(cfg, 3):
+        host.add(blk, prios, None)
+
+    dev_p = np.asarray(ring.take_prios())
+    leaves = host.tree.nodes[host.tree.leaf_offset:
+                             host.tree.leaf_offset + cfg.num_blocks * K]
+    np.testing.assert_allclose(dev_p, leaves[:dev_p.size], rtol=1e-6)
+    # the host tree behind the in-graph buffer stays untouched
+    assert buf.tree.nodes.sum() == 0.0
+
+
+def test_in_graph_sample_matches_host_index_arithmetic():
+    """Sampled ints bundles must reproduce sample_meta's arithmetic
+    (replay_buffer.py:372-390) and IS weights the reference formula on
+    exact densities; zero-priority leaves are never sampled."""
+    cfg = make_cfg()
+    K, L = cfg.seqs_per_block, cfg.learning_steps
+    buf, ring = filled(cfg, n_blocks=3)
+
+    prios = np.asarray(ring.take_prios())
+    meta = {k: np.asarray(v) for k, v in ring.per_meta().items()}
+    idx, w, ints = jax.jit(
+        lambda key, p, sm, fb: _in_graph_sample(cfg, key, p, sm, fb),
+    )(jax.random.PRNGKey(3), prios, meta["seq_meta"], meta["first"])
+    idx, w, ints = map(np.asarray, (idx, w, ints))
+
+    assert (prios[idx] > 0).all()
+    block_idx, seq_idx = idx // K, idx % K
+    burn = buf.burn_in_steps[block_idx, seq_idx]
+    start = buf.first_burn_in[block_idx] + seq_idx * L
+    expected = np.stack(
+        [block_idx, start - burn, seq_idx, burn,
+         buf.learning_steps[block_idx, seq_idx],
+         buf.forward_steps[block_idx, seq_idx]], axis=1)
+    np.testing.assert_array_equal(ints, expected)
+
+    q = prios[idx] / prios.sum()
+    np.testing.assert_allclose(
+        w, (q / q.min()) ** (-cfg.importance_sampling_exponent),
+        rtol=1e-5)
+
+
+def test_partial_block_add_keeps_padding_unsampleable():
+    """A short episode's partial block (num_sequences < K) must commit
+    cleanly — priorities arrive K-length zero-padded (block.py:108) and
+    the padding stays zero on device."""
+    cfg = make_cfg()
+    K = cfg.seqs_per_block
+    ring = DeviceRing(cfg, A)
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1),
+                       device_ring=ring)
+    rng = np.random.default_rng(5)
+    local = LocalBuffer(cfg, A)
+    local.reset(rng.integers(0, 256, cfg.stored_obs_shape, np.uint8))
+    for _ in range(max(1, cfg.block_length // 2 - 1)):
+        local.add(int(rng.integers(A)), 0.5,
+                  rng.integers(0, 256, cfg.stored_obs_shape, np.uint8),
+                  rng.normal(size=A).astype(np.float32),
+                  rng.normal(size=(2, cfg.lstm_layers,
+                                   cfg.hidden_dim)).astype(np.float32))
+    blk, prios, _ = local.finish(None)  # episode end -> partial block
+    assert blk.num_sequences < K
+    buf.add(blk, prios, 1.0)
+    dev_p = np.asarray(ring.take_prios())
+    assert (dev_p[blk.num_sequences:K] == 0).all()
+    assert (dev_p[:blk.num_sequences] > 0).any()
+
+
+def test_in_graph_sampling_distribution_is_proportional():
+    """Empirical draw frequencies track priorities (the sum-tree's
+    proportional contract) within sampling noise."""
+    cfg = make_cfg()
+    buf, ring = filled(cfg, n_blocks=3)
+    prios = np.asarray(ring.take_prios())
+    meta = ring.per_meta()
+    pj = jnp.asarray(prios)
+    f = jax.jit(lambda key: _in_graph_sample(cfg, key, pj,
+                                             meta["seq_meta"],
+                                             meta["first"])[0])
+    counts = np.zeros(prios.size)
+    draws = 400
+    for s in range(draws):
+        np.add.at(counts, np.asarray(f(jax.random.PRNGKey(s))), 1)
+    expect = prios / prios.sum() * counts.sum()
+    live = expect > 20  # only well-populated bins are statistically firm
+    assert live.any()
+    np.testing.assert_allclose(counts[live], expect[live], rtol=0.35)
+    assert counts[prios == 0].sum() == 0
+
+
+def test_in_graph_super_step_trains_and_scatters_feedback():
+    cfg = make_cfg(superstep_k=2)
+    buf, ring = filled(cfg, n_blocks=3)
+    net = create_network(cfg, A)
+    state = create_train_state(cfg, init_params(cfg, net,
+                                                jax.random.PRNGKey(0)))
+    p0 = np.asarray(ring.take_prios())
+    meta = ring.per_meta()
+    step0 = int(state.step)
+    fn = make_in_graph_per_super_step(cfg, net, 2)
+    state2, new_prios, losses = fn(state, ring.snapshot(),
+                                   ring.take_prios(), meta["seq_meta"],
+                                   meta["first"], jnp.asarray(7, jnp.uint32))
+    losses = np.asarray(losses)
+    assert losses.shape == (2,) and np.isfinite(losses).all()
+    assert int(state2.step) == step0 + 2
+    p1 = np.asarray(new_prios)
+    changed = np.nonzero(p1 != p0)[0]
+    assert changed.size > 0, "no priority feedback scattered"
+    assert (p0[changed] > 0).all(), "scatter touched an invalid leaf"
+    assert (p1[changed] >= 0).all()
+    # padding/empty leaves stay unsampleable
+    assert (p1[p0 == 0] == 0).all()
+
+
+def test_train_end_to_end_in_graph_per():
+    """Full threaded fabric with device PER: updates advance, losses are
+    finite, and the log plane's counters stay live through note_updates
+    (priority feedback never crosses the host)."""
+    from r2d2_tpu.train import train
+
+    cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=8,
+                   log_interval=0.2)
+    metrics = train(
+        cfg,
+        env_factory=lambda c, seed: FakeAtariEnv(
+            obs_shape=c.stored_obs_shape, action_dim=A, seed=seed),
+        verbose=False)
+    assert metrics["num_updates"] >= cfg.training_steps
+    assert np.isfinite(metrics["mean_loss"])
+    assert metrics["buffer_training_steps"] == metrics["num_updates"]
+    assert not metrics["fabric_failed"]
