@@ -183,7 +183,8 @@ def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64,
 
 def _system_bench(wall_seconds: float, *, device_replay: bool = True,
                   superstep_k: int = 4, num_actors: int = 64,
-                  env_workers: int = 0, superstep_pipeline: int = 2):
+                  env_workers: int = 0, superstep_pipeline: int = 2,
+                  in_graph_per: bool = False):
     """Steady-state env-frames/s of the full threaded fabric on fake envs.
 
     Returns (frames/s, top_spans, num_updates) where top_spans names the
@@ -209,6 +210,8 @@ def _system_bench(wall_seconds: float, *, device_replay: bool = True,
                                       # measures what the learning configs
                                       # actually run; tools/tune_system.py
                                       # sweeps the grid for the ceiling
+        in_graph_per=in_graph_per,    # device-resident PER: zero host
+                                      # round trips on the training path
         superstep_pipeline=superstep_pipeline,  # in-flight dispatches:
                                       # result copies start at enqueue, so
                                       # >=2 keeps the device busy while

@@ -428,11 +428,6 @@ class Learner:
             self._feed_back(meta, flat[:k], flat[k:].reshape(k, B),
                             priority_sink, losses_hist)
 
-        def gate() -> str:
-            if stop is not None and stop():
-                return "break"
-            return "go" if buffer.ready else "wait"
-
         def dispatch(ints, weights):
             with tracer.span("learner.step_dispatch"):
                 return compiled(self.state, ring.snapshot(),
@@ -442,9 +437,21 @@ class Learner:
             with tracer.span("learner.sample_meta"):
                 return buffer.sample_meta(k, dispatch=dispatch)
 
-        self._superstep_loop(k, target, t0, gate, sample, harvest,
-                             prepare=prepare)
+        self._superstep_loop(k, target, t0, self._ready_gate(buffer, stop),
+                             sample, harvest, prepare=prepare)
+        return self._finish_device_run(losses_hist, t0)
 
+    def _ready_gate(self, buffer, stop):
+        """The device drivetrains' shared gate(): stop-aware, waits for
+        ``learning_starts``."""
+        def gate() -> str:
+            if stop is not None and stop():
+                return "break"
+            return "go" if buffer.ready else "wait"
+        return gate
+
+    def _finish_device_run(self, losses_hist, t0: float) -> Dict[str, float]:
+        """Shared epilogue of the device drivetrains: final save + summary."""
         if self.checkpointer is not None:
             self._save(self.num_updates, t0)
         mins = self.start_minutes + (time.time() - t0) / 60.0
@@ -495,11 +502,7 @@ class Learner:
         compiled = super_fn
         losses_hist: deque = deque(maxlen=100)
         dispatch_no = [0]
-
-        def gate() -> str:
-            if stop is not None and stop():
-                return "break"
-            return "go" if buffer.ready else "wait"
+        gate = self._ready_gate(buffer, stop)
 
         def sample():
             with tracer.span("learner.step_dispatch"):
@@ -539,17 +542,7 @@ class Learner:
 
         self._superstep_loop(k, target, t0, gate, sample, harvest,
                              prepare=prepare)
-
-        if self.checkpointer is not None:
-            self._save(self.num_updates, t0)
-        mins = self.start_minutes + (time.time() - t0) / 60.0
-        return dict(
-            num_updates=self.num_updates,
-            env_steps=self.env_steps,
-            minutes=mins,
-            mean_loss=(float(np.mean(losses_hist))
-                       if losses_hist else float("nan")),
-        )
+        return self._finish_device_run(losses_hist, t0)
 
     def _superstep_loop(self, k: int, target: int, t0: float,
                         gate: Callable[[], str],

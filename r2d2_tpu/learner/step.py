@@ -261,18 +261,27 @@ def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
     """One prioritized batch draw on-device: (idx (B,), is_weights (B,)
     f32, ints (B, 6) i32).
 
-    Proportional sampling via ``jax.random.categorical`` over
-    log-priorities (B independent draws == the host sum-tree's
-    ``sample``, replay/sum_tree.py); zero-priority leaves (empty slots,
-    block padding) get -inf logits and are unsampleable.  IS weights are
-    the reference scheme on exact densities: w = (q/min q)^-beta with
-    q = p_i/sum p.  The ints bundle reproduces ``sample_meta``'s index
-    arithmetic (replay_buffer.py:372-390) from the device-resident
+    STRATIFIED proportional sampling, the host sum-tree's exact joint
+    scheme (replay/sum_tree.py:sample): the total mass splits into B
+    equal strata with one uniform draw each — same variance-reduced
+    batch composition, not just matching marginals — realised in-graph
+    as cumsum + searchsorted instead of B tree descents.  Zero-priority
+    leaves (empty slots, block padding) are zero-width cumsum bins,
+    unreachable with side='right'; the float-edge fallback snaps to the
+    max-priority leaf (the host's clamp guard analogue) so a scatter can
+    never make padding sampleable.  IS weights are the reference scheme:
+    w = (p/min sampled p)^-beta (identical to the host's, the mass
+    normalisation cancels).  The ints bundle reproduces ``sample_meta``'s
+    index arithmetic (replay_buffer.py:372-390) from the device-resident
     metadata, so ``gather_batch`` sees identical inputs either way."""
     K, L = cfg.seqs_per_block, cfg.learning_steps
     B = cfg.batch_size
-    logits = jnp.where(prios > 0, jnp.log(prios), -jnp.inf)
-    idx = jax.random.categorical(key, logits, shape=(B,))
+    total = prios.sum()
+    targets = (jnp.arange(B, dtype=jnp.float32)
+               + jax.random.uniform(key, (B,))) * (total / B)
+    idx = jnp.searchsorted(jnp.cumsum(prios), targets, side="right")
+    idx = jnp.minimum(idx, prios.shape[0] - 1)
+    idx = jnp.where(prios[idx] > 0, idx, jnp.argmax(prios))
     block_idx = idx // K
     seq_idx = (idx % K).astype(jnp.int32)
     meta = seq_meta[block_idx, seq_idx]                         # (B, 3)

@@ -21,14 +21,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 GRID = [
-    # (device_replay, superstep_k, num_actors, env_workers, pipeline)
+    # (device_replay, superstep_k, num_actors, env_workers, pipeline
+    #  [, in_graph_per])
     (True, 4, 64, 0, 2),    # the learning presets' cell (k=4 since the
                             # CURVES_AB_PIPELINE_r04 lag A/B)
+    (True, 4, 64, 0, 2, True),   # same cell, device-resident PER
     (True, 8, 64, 0, 2),
-    (True, 16, 64, 0, 1),
+    (True, 8, 64, 0, 2, True),
     (True, 16, 64, 0, 2),   # throughput-ceiling cells: how much system
-    (True, 32, 64, 0, 2),   # frames/s does the k=4 learning choice give
-    (True, 64, 64, 0, 2),   # up vs the raw maximum?
+    (True, 16, 64, 0, 2, True),  # frames/s does the k=4 learning choice
+    (True, 32, 64, 0, 2),   # give up vs the raw maximum?
     (False, 1, 64, 0, 1),   # host-staged baseline
 ]
 
@@ -50,11 +52,12 @@ def main(seconds: float = 60.0, grid=None,
     print(f"{'replay':>7} {'k':>3} {'actors':>6} {'workers':>7} {'pipe':>4} "
           f"{'frames/s':>12} {'updates':>8}  busiest_span")
     results = []
-    for device_replay, k, actors, workers, pipe in (GRID if grid is None
-                                                    else grid):
+    for cell in (GRID if grid is None else grid):
+        device_replay, k, actors, workers, pipe = cell[:5]
+        in_graph = bool(cell[5]) if len(cell) > 5 else False
         knobs = dict(device_replay=device_replay, superstep_k=k,
                      num_actors=actors, env_workers=workers,
-                     superstep_pipeline=pipe)
+                     superstep_pipeline=pipe, in_graph_per=in_graph)
         if inproc:
             try:
                 fps, top_spans, updates = _system_bench(seconds, **knobs)
@@ -78,10 +81,11 @@ def main(seconds: float = 60.0, grid=None,
         top = next(iter(top_spans), "-")
         results.append(dict(device_replay=device_replay, superstep_k=k,
                             num_actors=actors, env_workers=workers,
-                            superstep_pipeline=pipe,
+                            superstep_pipeline=pipe, in_graph_per=in_graph,
                             frames_per_sec=round(fps, 1), updates=updates,
                             busiest=top))
-        print(f"{'dev' if device_replay else 'host':>7} {k:>3} {actors:>6} "
+        tag = "dev+ig" if in_graph else ("dev" if device_replay else "host")
+        print(f"{tag:>7} {k:>3} {actors:>6} "
               f"{workers:>7} {pipe:>4} {fps:>12,.0f} {updates:>8}  {top}")
     with open(out, "w") as f:
         json.dump(results, f, indent=1)
