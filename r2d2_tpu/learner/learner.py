@@ -481,16 +481,22 @@ class Learner:
         the ring's current handle and the returned one is stored back
         before the buffer lock is released, so actor block commits
         (``DeviceRing.commit_per``, same lock) always target the newest
-        generation.  Single-process only for now (a mesh run would need
-        the sharded-super-step treatment of parallel/mesh.py)."""
+        generation.  Under a mesh the PER state replicates and the
+        sampled bundles are dp-constrained in-graph
+        (parallel/mesh.py:sharded_in_graph_per_super_step); multi-host
+        stays on the host-sampled path (per-host slabs)."""
         cfg = self.cfg
         if self.mesh is not None:
-            raise NotImplementedError(
-                "in_graph_per under a mesh is not yet supported — use the "
-                "host-sampled device-replay path (in_graph_per=False)")
-        from r2d2_tpu.learner.step import make_in_graph_per_super_step
+            from r2d2_tpu.parallel.mesh import (
+                sharded_in_graph_per_super_step,
+            )
 
-        super_fn = make_in_graph_per_super_step(cfg, self.net, k)
+            super_fn = sharded_in_graph_per_super_step(
+                cfg, self.net, self.mesh, k, state_template=self.state)
+        else:
+            from r2d2_tpu.learner.step import make_in_graph_per_super_step
+
+            super_fn = make_in_graph_per_super_step(cfg, self.net, k)
         meta_h = ring.per_meta()
         seed0 = jnp.asarray(0, jnp.uint32)
         try:

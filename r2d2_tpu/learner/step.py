@@ -295,7 +295,8 @@ def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
     return idx, w.astype(jnp.float32), ints_t
 
 
-def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int):
+def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
+                                    constrain=None):
     """``k`` fused steps with DEVICE-side PER: sample → gather → step →
     priority scatter, all inside one dispatch.
 
@@ -328,6 +329,12 @@ def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int):
             st, p = carry
             idx, w, ints_t = _in_graph_sample(cfg, key_t, p, seq_meta,
                                               first_burn)
+            if constrain is not None:
+                # mesh mode: the (replicated) sampled bundle's batch rows
+                # are pinned to dp here, so GSPMD shards the gather and
+                # the forward/backward over the mesh exactly as the
+                # host-sampled path's dp-sharded H2D bundles do
+                ints_t, w = constrain(ints_t, w)
             batch = gather_batch(cfg, arrays, ints_t, w)
             st, loss, new_p = step(st, batch)
             # feedback: same exponentiation the host tree applies

@@ -130,6 +130,24 @@ def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
             for k in DEVICE_BATCH_KEYS}
 
 
+def _validate_mesh_step(cfg: Config, mesh: Mesh,
+                        state_template: Optional[TrainState]):
+    """Shared guards + state sharding of every mesh-compiled step entry
+    (sharded_train_step / sharded_super_step /
+    sharded_in_graph_per_super_step): batch divisibility over dp, the mp
+    state-template requirement, and the replicated-or-derived state
+    sharding."""
+    if cfg.batch_size % mesh.shape["dp"] != 0:
+        raise ValueError(
+            f"batch_size {cfg.batch_size} not divisible by "
+            f"dp={mesh.shape['dp']}")
+    if "mp" in mesh.axis_names and state_template is None:
+        raise ValueError("an mp mesh needs state_template to derive "
+                         "per-parameter shardings")
+    return (state_shardings(mesh, state_template)
+            if state_template is not None else replicated(mesh))
+
+
 def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
                        state_template: Optional[TrainState] = None):
     """The jitted train step compiled over the mesh.
@@ -145,18 +163,10 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
     is required when the mesh has an ``mp`` axis so per-leaf shardings can
     be derived; a dp-only mesh replicates the whole state.
     """
-    if cfg.batch_size % mesh.shape["dp"] != 0:
-        raise ValueError(
-            f"batch_size {cfg.batch_size} not divisible by dp={mesh.shape['dp']}")
-    if "mp" in mesh.axis_names and state_template is None:
-        raise ValueError("an mp mesh needs state_template to derive "
-                         "per-parameter shardings")
+    st_shard = _validate_mesh_step(cfg, mesh, state_template)
     step = make_train_step(cfg, _mesh_net(cfg, net, mesh))
     repl = replicated(mesh)
     dp = NamedSharding(mesh, P("dp"))
-    st_shard = (state_shardings(mesh, state_template)
-                if state_template is not None
-                else repl)
     return jax.jit(
         step,
         in_shardings=(st_shard, {k: dp for k in DEVICE_BATCH_KEYS}),
@@ -230,12 +240,7 @@ def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
     host's slabs (learner/learner.py).
     """
     dp = mesh.shape["dp"]
-    if cfg.batch_size % dp != 0:
-        raise ValueError(
-            f"batch_size {cfg.batch_size} not divisible by dp={dp}")
-    if "mp" in mesh.axis_names and state_template is None:
-        raise ValueError("an mp mesh needs state_template to derive "
-                         "per-parameter shardings")
+    st_shard = _validate_mesh_step(cfg, mesh, state_template)
     from r2d2_tpu.learner.step import make_super_step_fn
     from r2d2_tpu.replay.device_ring import gather_batch, ring_sharding
 
@@ -268,13 +273,48 @@ def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
                             gather=gather)
     repl = replicated(mesh)
     dp_b = NamedSharding(mesh, P(None, "dp"))
-    st_shard = (state_shardings(mesh, state_template)
-                if state_template is not None else repl)
     return jax.jit(
         fn,
         in_shardings=(st_shard, ring_sharding(mesh, layout), dp_b, dp_b),
         out_shardings=(st_shard, repl, dp_b),
         donate_argnums=(0,),
+    )
+
+
+def sharded_in_graph_per_super_step(cfg: Config, net: R2D2Network,
+                                    mesh: Mesh, k: int,
+                                    state_template: Optional[TrainState]
+                                    = None):
+    """The device-PER super-step (learner/step.py:
+    make_in_graph_per_super_step_fn) compiled over the mesh.
+
+    The PER state (priorities, sampling metadata) is tiny and replicated;
+    sampling executes identically on every device (same fold_in key →
+    same stratified draws), then the bundle's batch rows are
+    sharding-constrained to dp so GSPMD shards the gather and the
+    forward/backward exactly as the host-sampled path does.  Replicated
+    ring layout only (config validation forbids explicit 'dp' +
+    in_graph_per, and resolve_layout refuses to auto-shard under it: dp
+    slabs sample per group on the host)."""
+    st_shard = _validate_mesh_step(cfg, mesh, state_template)
+    from r2d2_tpu.learner.step import make_in_graph_per_super_step_fn
+    from r2d2_tpu.replay.device_ring import ring_sharding
+
+    dp_rows = NamedSharding(mesh, P("dp"))
+
+    def constrain(ints_t, w_t):
+        return (jax.lax.with_sharding_constraint(ints_t, dp_rows),
+                jax.lax.with_sharding_constraint(w_t, dp_rows))
+
+    fn = make_in_graph_per_super_step_fn(cfg, _mesh_net(cfg, net, mesh), k,
+                                         constrain=constrain)
+    repl = replicated(mesh)
+    return jax.jit(
+        fn,
+        in_shardings=(st_shard, ring_sharding(mesh, "replicated"),
+                      repl, repl, repl, repl),
+        out_shardings=(st_shard, repl, repl),
+        donate_argnums=(0, 2),
     )
 
 

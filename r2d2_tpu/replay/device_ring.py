@@ -185,6 +185,15 @@ def resolve_layout(cfg: Config, mesh, need_bytes: int,
         return "replicated"
     # "auto": replicate if it fits, shard if it must and can
     if can_dp and cap_bytes is not None and need_bytes > 0.8 * cap_bytes:
+        if getattr(cfg, "in_graph_per", False):
+            # dp slabs sample on the host — incompatible with device PER.
+            # Fail HERE with the remedy, not at ring construction.
+            raise ValueError(
+                f"in_graph_per needs a replicated ring, but the ring "
+                f"({need_bytes / 1e9:.1f} GB) exceeds one device's HBM "
+                f"budget ({0.8 * cap_bytes / 1e9:.1f} GB) — shrink "
+                "buffer_capacity, or set in_graph_per=False to allow "
+                "the dp-sharded layout")
         return "dp"
     return "replicated"
 

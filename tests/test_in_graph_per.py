@@ -179,6 +179,41 @@ def test_in_graph_super_step_trains_and_scatters_feedback():
     assert (p1[p0 == 0] == 0).all()
 
 
+def test_in_graph_per_sharded_matches_single_device():
+    """dp=8 mesh device-PER super-step == single-device: same losses,
+    same scattered priorities, same params (sampling is deterministic
+    given the fold_in key, so the mesh run draws identical strata)."""
+    from r2d2_tpu.parallel.mesh import (
+        make_mesh, replicate_state, sharded_in_graph_per_super_step,
+    )
+
+    cfg = make_cfg(superstep_k=2)
+    buf, ring = filled(cfg, n_blocks=3)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    meta = ring.per_meta()
+    p_start = np.asarray(ring.take_prios())
+    idx7 = jnp.asarray(7, jnp.uint32)
+
+    s1, p1, l1 = make_in_graph_per_super_step(cfg, net, 2)(
+        create_train_state(cfg, params), ring.snapshot(),
+        jnp.asarray(p_start), meta["seq_meta"], meta["first"], idx7)
+
+    mesh = make_mesh(cfg)
+    stepN = sharded_in_graph_per_super_step(cfg, net, mesh, 2)
+    sN, pN, lN = stepN(
+        replicate_state(mesh, create_train_state(cfg, params)),
+        ring.snapshot(), jnp.asarray(p_start), meta["seq_meta"],
+        meta["first"], idx7)
+
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(lN), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(pN),
+                               rtol=1e-4, atol=1e-7)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(sN.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
 def test_train_end_to_end_in_graph_per():
     """Full threaded fabric with device PER: updates advance, losses are
     finite, and the log plane's counters stay live through note_updates
