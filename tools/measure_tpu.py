@@ -138,6 +138,38 @@ def pallas_lstm_section(quick: bool) -> None:
           "on a dp=1 mesh", flush=True)
 
 
+def _fused_unroll_section(base_cfg, A: int) -> None:
+    """Step time with/without cfg.fused_double_unroll (one vmapped unroll
+    over stacked online+target params — the B=128/B=64 fwd ratio of 1.30
+    predicts a win; this measures the whole train step)."""
+    try:
+        def time_step(c, label):
+            n = create_network(c, A)
+            p = init_params(c, n, jax.random.PRNGKey(0))
+            st = create_train_state(c, p)
+            fn = jit_train_step(c, n)
+            b = {k_: jax.device_put(v) for k_, v in
+                 synthetic_batch(c, A, np.random.default_rng(0)).items()}
+            for _ in range(5):
+                st, loss, _pr = fn(st, b)
+            float(jax.device_get(loss))
+            t0 = time.perf_counter()
+            for _ in range(30):
+                st, loss, _pr = fn(st, b)
+            float(jax.device_get(loss))
+            ms = (time.perf_counter() - t0) / 30 * 1000
+            print(f"train step [{label}]: {ms:.2f} ms", flush=True)
+            return ms
+
+        t_plain = time_step(base_cfg, "two unrolls")
+        t_fused = time_step(base_cfg.replace(fused_double_unroll=True),
+                            "fused double unroll")
+        print(f"fused double unroll: {t_plain / t_fused:.2f}x", flush=True)
+    except Exception as e:
+        print(f"fused-unroll section FAILED: {type(e).__name__}: {e}",
+              flush=True)
+
+
 def main(quick: bool = False) -> None:
     from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
 
@@ -241,6 +273,7 @@ def main(quick: bool = False) -> None:
         float(jax.device_get(loss))
         dt = time.perf_counter() - t0
         print(f"learner micro (quick cfg): {5 / dt:.1f} steps/s", flush=True)
+        _fused_unroll_section(cfg, A)  # smoke 3b at quick shapes too
         print("QUICK SMOKE DONE (sections 4-5 need the real chip)",
               flush=True)
         return
@@ -250,6 +283,9 @@ def main(quick: bool = False) -> None:
     fps, sps, flops = _learner_micro_bench(steps=100, warmup=5)
     print(f"learner micro: {sps:.1f} steps/s = {fps:,.0f} frames/s "
           f"(flops/step={flops:.3e})", flush=True)
+
+    # --- 3b. fused double unroll at flagship shapes
+    _fused_unroll_section(cfg, A)
 
     # --- 4. system bench grid — tune_system's sweep with this battery's
     # candidate cells (shared measurement + persisted JSON, no drift)
@@ -266,10 +302,12 @@ def main(quick: bool = False) -> None:
     tune_system.main(seconds=120.0, grid=[
         (True, 4, 64, 0, 2),    # the learning presets' cell (post
                                 # CURVES_AB_PIPELINE_r04 lag A/B)
+        (True, 4, 64, 0, 2, True),   # same cell, device-resident PER —
+                                     # the result_sync RTT should vanish
         (True, 8, 64, 0, 2),
+        (True, 8, 64, 0, 2, True),
         (True, 16, 64, 0, 2),   # throughput-ceiling cells
-        (True, 32, 64, 0, 2),
-        (True, 4, 64, 0, 1),
+        (True, 16, 64, 0, 2, True),
     ], out="measure_tpu_grid.json",  # never clobber a full sweep's JSON
         inproc=True)
 

@@ -8,7 +8,12 @@ Checks at exit: zero fabric failures, exact priority accounting (buffer
 counter == learner counter), no throughput decay (last-third updates/s
 within 20% of the middle third), and prints the health/trace summary.
 
-Run:  python tools/soak.py [minutes] [--device] [--out OUT.json]
+Run:  python tools/soak.py [minutes] [--device] [--ingraph]
+          [--out OUT.json]
+
+``--ingraph`` soaks the device-PER drivetrain (cfg.in_graph_per):
+priority feedback never crosses the host, and note_updates keeps the
+accounting check exact.
 """
 import json
 import os
@@ -19,11 +24,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _argv = sys.argv[1:]
 DEVICE = "--device" in _argv
+INGRAPH = "--ingraph" in _argv
 OUT = None
 if "--out" in _argv:
     i = _argv.index("--out")
     if i + 1 >= len(_argv):
-        sys.exit("usage: soak.py [minutes] [--device] [--out OUT.json]")
+        sys.exit("usage: soak.py [minutes] [--device] [--ingraph] "
+                 "[--out OUT.json]")
     OUT = _argv[i + 1]
     _argv = _argv[:i] + _argv[i + 2:]
 args = [a for a in _argv if not a.startswith("--")]
@@ -49,6 +56,7 @@ def main(minutes: float = 20.0) -> int:
         burn_in_steps=8, learning_steps=8, forward_steps=2,
         block_length=32, buffer_capacity=25600, learning_starts=1600,
         device_replay=True, superstep_k=4, superstep_pipeline=2,
+        in_graph_per=INGRAPH,
         actor_fleets=2, env_workers=2,
         training_steps=10**9, log_interval=10.0)
     t0 = time.time()
