@@ -277,7 +277,7 @@ def _device_probe(timeout_s: float = 240.0):
         return False, f"device probe error: {type(e).__name__}: {e}"
 
 
-def _run_phase(phase: str, timeout_s: float, extra=()):
+def _run_phase(phase: str, timeout_s: float, extra=(), label=None):
     """Run one bench phase as a bounded subprocess; (result_dict, reason).
 
     Each phase holds its own backend claim and releases it on clean exit;
@@ -288,6 +288,7 @@ def _run_phase(phase: str, timeout_s: float, extra=()):
     hands the chip claim between processes."""
     import subprocess
 
+    label = label or phase
     cmd = [sys.executable, "-m", "r2d2_tpu.bench", "--phase", phase,
            *map(str, extra)]
     # the package is run from a source tree, not installed: the child can
@@ -305,13 +306,13 @@ def _run_phase(phase: str, timeout_s: float, extra=()):
                 proc.communicate(timeout=10.0)  # bounded reap (see
             except Exception:                   # _device_probe)
                 pass
-            return None, (f"{phase} phase wedged (no result after "
+            return None, (f"{label} phase wedged (no result after "
                           f"{timeout_s:.0f}s; child killed)")
     except Exception as e:
-        return None, f"{phase} phase spawn error: {type(e).__name__}: {e}"
+        return None, f"{label} phase spawn error: {type(e).__name__}: {e}"
     tail = (err or b"").decode(errors="replace").strip().splitlines()
     if proc.returncode != 0:
-        return None, (f"{phase} phase failed (rc={proc.returncode}): "
+        return None, (f"{label} phase failed (rc={proc.returncode}): "
                       + " | ".join(tail[-3:]))
     for line in reversed((out or b"").decode(errors="replace").splitlines()):
         line = line.strip()
@@ -320,7 +321,7 @@ def _run_phase(phase: str, timeout_s: float, extra=()):
                 return json.loads(line), ""
             except Exception:
                 break
-    return None, f"{phase} phase emitted no JSON: " + " | ".join(tail[-3:])
+    return None, f"{label} phase emitted no JSON: " + " | ".join(tail[-3:])
 
 
 def _phase_main(argv) -> int:
@@ -369,6 +370,7 @@ def _main_isolated(steps: int, warmup: int, system_seconds: float) -> None:
         sys.exit(1)
 
     system_knobs = dict(FLAGSHIP_SYSTEM_KNOBS)
+    ig_knobs = dict(FLAGSHIP_SYSTEM_KNOBS, in_graph_per=True)
     # compile slack + 1 s/step: a deliberately long `bench.py 20000` run
     # must not be misreported as a wedge
     micro, m_err = _run_phase("micro", 900.0 + (steps + warmup) * 1.0,
@@ -376,6 +378,12 @@ def _main_isolated(steps: int, warmup: int, system_seconds: float) -> None:
     system, s_err = _run_phase(
         "system", system_seconds + 900.0,
         ("--seconds", system_seconds, "--knobs", json.dumps(system_knobs)))
+    # the same cell on the device-PER drivetrain (in_graph_per): zero
+    # host round trips on the training path — reported side by side
+    system_ig, ig_err = _run_phase(
+        "system", system_seconds + 900.0,
+        ("--seconds", system_seconds, "--knobs", json.dumps(ig_knobs)),
+        label="system_ingraph")
     actor, a_err = _run_phase("actor", 600.0)
 
     result = {
@@ -389,11 +397,14 @@ def _main_isolated(steps: int, warmup: int, system_seconds: float) -> None:
         "system_vs_baseline": (round(system["system_fps"] / NORTH_STAR_FPS,
                                      3) if system else -1.0),
         "system_knobs": system_knobs,
+        "system_ingraph_env_frames_per_sec": (
+            round(system_ig["system_fps"], 1) if system_ig else -1.0),
         "actor_env_frames_per_sec": (round(actor["actor_fps"], 1)
                                      if actor else -1.0),
         "host_cpus": os.cpu_count() or 0,
     }
     errors = {k: v for k, v in (("micro", m_err), ("system", s_err),
+                                ("system_ingraph", ig_err),
                                 ("actor", a_err)) if v}
     if errors:
         result["phase_errors"] = errors
@@ -481,6 +492,15 @@ def main(steps: int = 100, warmup: int = 5,
     except Exception:
         traceback.print_exc()
         system_fps, top_spans, sys_updates = -1.0, {}, 0
+    # same cell on the device-PER drivetrain — schema parity with the
+    # script-mode (phase-isolated) artifact
+    try:
+        system_ig_fps, _, _ = _system_bench(
+            system_seconds, **dict(FLAGSHIP_SYSTEM_KNOBS,
+                                   in_graph_per=True))
+    except Exception:
+        traceback.print_exc()
+        system_ig_fps = -1.0
 
     result = {
         "metric": "learner_env_frames_per_sec",
@@ -493,6 +513,7 @@ def main(steps: int = 100, warmup: int = 5,
         # presets' cell — CURVES_AB_PIPELINE_r04's k=4 choice), so the
         # artifact documents what was measured
         "system_knobs": system_knobs,
+        "system_ingraph_env_frames_per_sec": round(system_ig_fps, 1),
         "actor_env_frames_per_sec": round(actor_fps, 1),
         # the actor/system planes are host-CPU-bound work: their numbers
         # only compare across machines with this context attached

@@ -74,7 +74,7 @@ def test_isolated_bench_composes_phase_results(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_device_probe", lambda *a, **k: (True, ""))
 
-    def fake_run_phase(phase, timeout_s, extra=()):
+    def fake_run_phase(phase, timeout_s, extra=(), label=None):
         if phase == "micro":
             return (dict(learner_fps=100000.0, steps_per_sec=40.0,
                          flops=2e9, platform="tpu",
@@ -101,7 +101,7 @@ def test_isolated_bench_headline_failure_exits_nonzero(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_device_probe", lambda *a, **k: (True, ""))
     monkeypatch.setattr(bench, "_run_phase",
-                        lambda phase, t, extra=(): (None, f"{phase} died"))
+                        lambda phase, t, extra=(), label=None: (None, f"{label or phase} died"))
     import pytest
 
     with pytest.raises(SystemExit) as ex:
@@ -109,7 +109,8 @@ def test_isolated_bench_headline_failure_exits_nonzero(monkeypatch, capsys):
     assert ex.value.code == 1
     result = json.loads(capsys.readouterr().out.strip().splitlines()[0])
     assert result["value"] == -1.0
-    assert set(result["phase_errors"]) == {"micro", "system", "actor"}
+    assert set(result["phase_errors"]) == {"micro", "system",
+                                           "system_ingraph", "actor"}
 
 
 def test_run_phase_parses_last_json_line(monkeypatch):
