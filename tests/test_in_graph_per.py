@@ -215,13 +215,15 @@ def test_in_graph_per_sharded_matches_single_device():
 
 
 def test_train_end_to_end_in_graph_per():
-    """Full threaded fabric with device PER: updates advance, losses are
-    finite, and the log plane's counters stay live through note_updates
-    (priority feedback never crosses the host)."""
+    """Full threaded fabric with device PER (composed with the fused
+    double unroll — the two round-4 features are orthogonal: sampling
+    plane vs loss path): updates advance, losses are finite, and the
+    log plane's counters stay live through note_updates (priority
+    feedback never crosses the host)."""
     from r2d2_tpu.train import train
 
     cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=8,
-                   log_interval=0.2)
+                   fused_double_unroll=True, log_interval=0.2)
     metrics = train(
         cfg,
         env_factory=lambda c, seed: FakeAtariEnv(
