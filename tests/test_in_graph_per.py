@@ -214,16 +214,20 @@ def test_in_graph_per_sharded_matches_single_device():
                                    rtol=1e-4, atol=1e-6)
 
 
-def test_train_end_to_end_in_graph_per():
-    """Full threaded fabric with device PER (composed with the fused
-    double unroll — the two round-4 features are orthogonal: sampling
-    plane vs loss path): updates advance, losses are finite, and the
-    log plane's counters stay live through note_updates (priority
-    feedback never crosses the host)."""
+import pytest
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_train_end_to_end_in_graph_per(fused):
+    """Full threaded fabric with device PER, at both loss paths (the
+    default two-unroll and the fused double unroll — orthogonal
+    features: sampling plane vs loss path): updates advance, losses are
+    finite, and the log plane's counters stay live through note_updates
+    (priority feedback never crosses the host)."""
     from r2d2_tpu.train import train
 
     cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=8,
-                   fused_double_unroll=True, log_interval=0.2)
+                   fused_double_unroll=fused, log_interval=0.2)
     metrics = train(
         cfg,
         env_factory=lambda c, seed: FakeAtariEnv(

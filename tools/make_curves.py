@@ -22,7 +22,12 @@ Modes (composable):
   raw 24×24 frames, IMPALA residual stacks, 2-layer LSTM.
   Mutually exclusive with ``--nature``.
 
-Run:  python tools/make_curves.py [out.json] [--fabric] [--nature|--impala] [--seed N]
+Run:  python tools/make_curves.py [out.json] [--fabric]
+          [--nature|--impala] [--ingraph] [--seed N]
+
+``--ingraph`` (requires --fabric) runs the device-PER drivetrain
+(cfg.in_graph_per) — learning evidence for the zero-host-round-trip
+sampling/feedback plane on the production families.
 """
 import json
 import os
@@ -52,7 +57,8 @@ def env_factory(cfg, seed):
 
 
 def main(out_path: str = None, fabric: bool = False,
-         torso: str = "mlp", seed: int = 0) -> None:
+         torso: str = "mlp", seed: int = 0,
+         ingraph: bool = False) -> None:
     if out_path is None:
         # mode-derived defaults so `--fabric`/`--nature`/`--seed` can
         # never silently overwrite another mode's evidence artifact
@@ -60,6 +66,8 @@ def main(out_path: str = None, fabric: bool = False,
                 else "CURVES")
         if fabric:
             stem += "_FABRIC"
+        if ingraph:
+            stem += "_INGRAPH"
         suffix = f"_s{seed}" if seed else ""
         out_path = f"{stem}_r04{suffix}.json"
     # lr is deliberately NOT the reference's 1e-4: that value is tuned for
@@ -93,7 +101,10 @@ def main(out_path: str = None, fabric: bool = False,
         # pipelined harvest + two actor fleets.  save_interval stays dense
         # (cadences fire on interval crossings, learner.py).
         cfg = cfg.replace(num_actors=4, actor_fleets=2, device_replay=True,
-                          superstep_k=4, superstep_pipeline=2)
+                          superstep_k=4, superstep_pipeline=2,
+                          in_graph_per=ingraph)
+    elif ingraph:
+        raise SystemExit("--ingraph requires --fabric (device replay)")
     ckpt_dir = os.path.join(os.path.dirname(out_path) or ".",
                             "_curves_ckpts")
     # stale checkpoints from a previous run (possibly a different arch or
@@ -125,6 +136,7 @@ def main(out_path: str = None, fabric: bool = False,
                  "stand-in; ALE absent in this image)",
         env="FakeAtariEnv learnable POMDP (envs/fake.py)",
         trainer=(f"threaded fabric: device_replay={cfg.device_replay}, "
+                 f"in_graph_per={cfg.in_graph_per}, "
                  f"superstep_k={cfg.superstep_k}, "
                  f"pipeline={cfg.superstep_pipeline}, "
                  f"{cfg.actor_fleets} actor fleets" if fabric
@@ -142,6 +154,7 @@ def main(out_path: str = None, fabric: bool = False,
                     # train_sync forces pipeline 0 / no supersteps
                     **(dict(actor_fleets=cfg.actor_fleets,
                             device_replay=cfg.device_replay,
+                            in_graph_per=cfg.in_graph_per,
                             superstep_k=cfg.superstep_k,
                             superstep_pipeline=cfg.superstep_pipeline)
                        if fabric else {})),
@@ -170,7 +183,7 @@ if __name__ == "__main__":
     torso = ("nature" if "--nature" in argv
              else "impala" if "--impala" in argv else "mlp")
     usage = ("usage: make_curves.py [out.json] [--fabric] "
-             "[--nature|--impala] [--seed N]")
+             "[--nature|--impala] [--ingraph] [--seed N]")
     seed = 0
     if "--seed" in argv:
         i = argv.index("--seed")
@@ -179,8 +192,9 @@ if __name__ == "__main__":
         except (IndexError, ValueError):
             sys.exit(usage)
         argv = argv[:i] + argv[i + 2:]
-    args = [a for a in argv if a not in ("--fabric", "--nature", "--impala")]
+    args = [a for a in argv
+            if a not in ("--fabric", "--nature", "--impala", "--ingraph")]
     if any(a.startswith("--") for a in args):
         sys.exit(usage)  # e.g. a mistyped --seed=1 must not become out_path
     main(args[0] if args else None, fabric="--fabric" in argv,
-         torso=torso, seed=seed)
+         torso=torso, seed=seed, ingraph="--ingraph" in argv)
