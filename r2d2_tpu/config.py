@@ -134,7 +134,11 @@ class Config:
                                       # fresh priorities (the host path's
                                       # feedback lags >= k updates).
                                       # Requires device_replay, replicated
-                                      # ring layout
+                                      # ring layout.  Off by default only
+                                      # because the r4 outage prevented
+                                      # on-chip timing; CPU-measured 2.2x
+                                      # the host path with learning parity
+                                      # on all three network families
     fused_double_unroll: bool = False  # compute the online+target forwards
                                       # as ONE unroll vmapped over stacked
                                       # params: half the sequential LSTM
