@@ -179,6 +179,51 @@ def test_in_graph_super_step_trains_and_scatters_feedback():
     assert (p1[p0 == 0] == 0).all()
 
 
+def test_in_graph_scatter_writes_host_equivalent_priorities():
+    """The in-scan priority scatter must write exactly what the host
+    feedback path would: td**alpha of the mixed-TD priorities the train
+    step computes for the same sampled batch.  Cross-checked by
+    replaying the (deterministic) stratified draw on the host and
+    running the plain train step on the identically gathered batch."""
+    from r2d2_tpu.learner.step import jit_train_step
+    from r2d2_tpu.replay.device_ring import gather_batch
+
+    cfg = make_cfg(superstep_k=1)
+    buf, ring = filled(cfg, n_blocks=3)
+    net = create_network(cfg, A)
+    state = create_train_state(cfg, init_params(cfg, net,
+                                                jax.random.PRNGKey(0)))
+    meta = ring.per_meta()
+    p0 = jnp.asarray(np.asarray(ring.take_prios()))
+    dispatch_idx = jnp.asarray(3, jnp.uint32)
+
+    # replay the super-step's exact key schedule for k=1, step 0
+    key0 = jax.random.split(
+        jax.random.fold_in(jax.random.PRNGKey(cfg.seed), dispatch_idx),
+        1)[0]
+    idx, w, ints = map(np.asarray, _in_graph_sample(
+        cfg, key0, p0, meta["seq_meta"], meta["first"]))
+
+    # plain train step on the identically gathered batch
+    batch = gather_batch(cfg, ring.snapshot(), jnp.asarray(ints),
+                         jnp.asarray(w))
+    _, _, prios_ref = jit_train_step(cfg, net)(state, batch)
+
+    # the in-graph super-step (fresh state: the first one was donated;
+    # snapshot p0 to host BEFORE the call donates it)
+    p0_np = np.asarray(p0).copy()
+    state2 = create_train_state(cfg, init_params(cfg, net,
+                                                 jax.random.PRNGKey(0)))
+    fn = make_in_graph_per_super_step(cfg, net, 1)
+    _, new_prios, _ = fn(state2, ring.snapshot(), p0, meta["seq_meta"],
+                         meta["first"], dispatch_idx)
+
+    expected = p0_np
+    expected[idx] = np.asarray(prios_ref) ** cfg.prio_exponent
+    np.testing.assert_allclose(np.asarray(new_prios), expected,
+                               rtol=1e-5, atol=1e-7)
+
+
 def test_in_graph_per_sharded_matches_single_device():
     """dp=8 mesh device-PER super-step == single-device: same losses,
     same scattered priorities, same params (sampling is deterministic
