@@ -3,7 +3,7 @@
 # measurement battery (tools/measure_tpu.py), then the headline bench.
 # One TPU process at a time, all internally bounded, never killed
 # externally (axon tunnel discipline).
-cd /root/repo
+cd /root/repo || exit 1
 python tools/probe_loop.py 600 180 12 || { echo "{\"event\": \"probe gave up $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl; exit 1; }
 echo "{\"event\": \"tunnel healthy — starting battery $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
 python tools/measure_tpu.py > /tmp/measure_tpu_r04.log 2>&1

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-4 recovery watcher: the moment the tunnel answers, capture the
+# on-chip numbers with ONLY bounded-subprocess measurements (bench.py
+# phase isolation + tune_system subprocess cells).  The in-process
+# battery (measure_tpu.py) is deliberately NOT run here: an in-process
+# wedge would hold the chip claim into the driver's round-end bench.
+# (tools/probe_then_measure.sh is the battery-running sibling for
+# interactive use — different payload, same probe/status protocol.)
+#
+# Probe cadence 300s with a 120s bound leaves ~180s idle between claim
+# attempts, so a recovered tunnel (or the driver's own bench) never
+# contends with a back-to-back probe child.
+cd /root/repo || exit 1
+python tools/probe_loop.py 300 120 12 || { echo "{\"event\": \"watcher probe gave up $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl; exit 1; }
+echo "{\"event\": \"tunnel healthy — bench preview $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
+python bench.py > BENCH_r04_preview.json 2> BENCH_r04_preview.err
+echo "{\"event\": \"bench preview rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
+python tools/tune_system.py 120 > tune_r04_recovered.log 2>&1
+echo "{\"event\": \"sweep rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
