@@ -11,7 +11,13 @@ Each cell IS bench.py's ``_system_bench`` measurement (same config base,
 same steady-state estimator) with the knobs overridden, so the sweep's
 numbers are directly comparable to what bench.py reports.
 
-Run on the TPU host:  python tools/tune_system.py [seconds_per_cell]
+Run on the TPU host:
+    python tools/tune_system.py [seconds_per_cell] [--short]
+        [--out OUT.json] [--slack SECONDS]
+
+``--short`` sweeps only SHORT_GRID (the three decisive cells — bounded
+enough for a recovery watcher); ``--slack`` sets the per-cell subprocess
+timeout slack beyond the measurement wall.
 """
 import json
 import os
@@ -33,6 +39,11 @@ GRID = [
     (True, 32, 64, 0, 2),   # give up vs the raw maximum?
     (False, 1, 64, 0, 1),   # host-staged baseline
 ]
+
+# the three decisive cells (--short): the learning presets' cell, the
+# same cell on device PER, and the device-PER throughput ceiling —
+# derived from GRID so the two can never drift
+SHORT_GRID = [GRID[0], GRID[1], GRID[5]]
 
 
 def main(seconds: float = 60.0, grid=None,
@@ -93,4 +104,15 @@ def main(seconds: float = 60.0, grid=None,
 
 
 if __name__ == "__main__":
-    main(float(sys.argv[1]) if len(sys.argv) > 1 else 60.0)
+    _argv = sys.argv[1:]
+    _kw = {}
+    if "--short" in _argv:
+        _argv.remove("--short")
+        _kw["grid"] = SHORT_GRID
+    for _flag, _key, _cast in (("--out", "out", str),
+                               ("--slack", "cell_timeout_slack", float)):
+        if _flag in _argv:
+            _i = _argv.index(_flag)
+            _kw[_key] = _cast(_argv[_i + 1])
+            _argv = _argv[:_i] + _argv[_i + 2:]
+    main(float(_argv[0]) if _argv else 60.0, **_kw)

@@ -15,5 +15,9 @@ python tools/probe_loop.py 300 120 12 || { echo "{\"event\": \"watcher probe gav
 echo "{\"event\": \"tunnel healthy — bench preview $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
 python bench.py > BENCH_r04_preview.json 2> BENCH_r04_preview.err
 echo "{\"event\": \"bench preview rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
-python tools/tune_system.py 120 > tune_r04_recovered.log 2>&1
+# short sweep (tune_system.SHORT_GRID): only the three decisive cells,
+# tight per-cell bounds, so a late recovery can't hold the claim into
+# the driver's round-end bench (worst case ~27 min if every cell wedges)
+python tools/tune_system.py 120 --short --out tune_r04_recovered.json \
+    --slack 420 > tune_r04_recovered.log 2>&1
 echo "{\"event\": \"sweep rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
