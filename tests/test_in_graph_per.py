@@ -282,3 +282,32 @@ def test_train_end_to_end_in_graph_per(fused):
     assert np.isfinite(metrics["mean_loss"])
     assert metrics["buffer_training_steps"] == metrics["num_updates"]
     assert not metrics["fabric_failed"]
+
+
+def test_compensated_cumsum_matches_f64():
+    """_compensated_cumsum's f32 prefixes must agree with a float64
+    oracle at stratum-boundary resolution across flagship-scale leaf
+    arrays — the host SumTree accumulates in f64 (replay/sum_tree.py),
+    and a plain f32 cumsum drifts enough to shift boundaries."""
+    from r2d2_tpu.learner.step import _compensated_cumsum
+
+    fn = jax.jit(_compensated_cumsum)
+    diffs = plain_diffs = 0
+    for seed in range(8):
+        rng = np.random.default_rng(seed)
+        x = (rng.random(50_000) * rng.exponential(1, 50_000)).astype(
+            np.float32)
+        x[rng.random(50_000) < 0.3] = 0.0   # padding slots
+        ref = np.cumsum(x.astype(np.float64))
+        hi = np.asarray(fn(jnp.asarray(x)))
+        u = rng.random(64)
+        t64 = (np.arange(64) + u) * (ref[-1] / 64)
+        t32 = ((np.arange(64, dtype=np.float32) + u.astype(np.float32))
+               * (hi[-1].astype(np.float32) / np.float32(64)))
+        diffs += int(np.sum(np.searchsorted(ref, t64, side="right")
+                            != np.searchsorted(hi, t32, side="right")))
+        plain_diffs += int(np.sum(
+            np.searchsorted(ref, t64, side="right")
+            != np.searchsorted(np.cumsum(x), t32, side="right")))
+    assert diffs == 0
+    assert plain_diffs > 0  # the plain-f32 drift this guards against

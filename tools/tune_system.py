@@ -113,6 +113,10 @@ if __name__ == "__main__":
                                ("--slack", "cell_timeout_slack", float)):
         if _flag in _argv:
             _i = _argv.index(_flag)
+            if _i + 1 >= len(_argv):
+                sys.exit(f"usage: tune_system.py [seconds] [--short] "
+                         f"[--out OUT.json] [--slack SECONDS] "
+                         f"({_flag} needs a value)")
             _kw[_key] = _cast(_argv[_i + 1])
             _argv = _argv[:_i] + _argv[_i + 2:]
     main(float(_argv[0]) if _argv else 60.0, **_kw)
