@@ -41,6 +41,18 @@ from r2d2_tpu.parallel.mesh import (
 )
 from r2d2_tpu.utils.store import ParamStore
 
+def _aval_tree(tree):
+    """ShapeDtypeStruct avals (shape/dtype/sharding) for every leaf —
+    for AOT-lowering a super-step WITHOUT touching live device buffers.
+    Call under the buffer lock when the leaves are donated ring handles:
+    a concurrent actor commit donates them, and lowering from a live
+    array could read a deleted buffer (ADVICE r4)."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            jnp.shape(x), x.dtype, sharding=getattr(x, "sharding", None)),
+        tree)
+
+
 # batch_source() -> host batch dict (blocking); returns None to stop early.
 BatchSource = Callable[[], Optional[Dict[str, np.ndarray]]]
 # priority_sink(idxes, priorities, old_ptr, loss)
@@ -395,11 +407,7 @@ class Learner:
         # snapshotted under the buffer lock; lowering touches no device
         # memory (same discipline as _run_device_in_graph_per).
         with buffer.lock:
-            snap_avals = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(
-                    jnp.shape(x), x.dtype,
-                    sharding=getattr(x, "sharding", None)),
-                (self.state, ring.snapshot()))
+            snap_avals = _aval_tree((self.state, ring.snapshot()))
         try:
             super_fn = super_fn.lower(
                 *snap_avals,
@@ -517,12 +525,9 @@ class Learner:
         # touches no device memory.
         with buffer.lock:
             meta_h = ring.per_meta()
-            lower_args = (self.state, ring.snapshot(), ring.take_prios(),
-                          meta_h["seq_meta"], meta_h["first"], seed0)
-            avals = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(
-                    jnp.shape(x), x.dtype,
-                    sharding=getattr(x, "sharding", None)), lower_args)
+            avals = _aval_tree(
+                (self.state, ring.snapshot(), ring.take_prios(),
+                 meta_h["seq_meta"], meta_h["first"], seed0))
         try:
             super_fn = super_fn.lower(*avals).compile()
         except Exception:

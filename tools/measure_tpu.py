@@ -32,6 +32,11 @@ import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 QUICK = "--quick" in sys.argv[1:]
+# --nogrid: skip section 4 (the in-process tune_system sweep cells — the
+# round-4 k=16 wedge lived there).  The recovery watcher runs the grid
+# separately via tune_system.py's bounded-subprocess cells instead, so a
+# watcher-launched battery can never wedge the claim on a sweep cell.
+NOGRID = "--nogrid" in sys.argv[1:]
 if QUICK:
     import jax
 
@@ -289,6 +294,24 @@ def main(quick: bool = False) -> None:
 
     # --- 4. system bench grid — tune_system's sweep with this battery's
     # candidate cells (shared measurement + persisted JSON, no drift)
+    if NOGRID:
+        print("grid: SKIPPED (--nogrid; run tools/tune_system.py "
+              "separately for bounded-subprocess cells)", flush=True)
+    else:
+        _grid_section()
+
+    # --- 5. actor plane ---
+    from r2d2_tpu.bench import _actor_plane_bench
+
+    try:
+        print(f"actor plane: {_actor_plane_bench():,.0f} frames/s",
+              flush=True)
+    except Exception as e:
+        print(f"actor plane FAILED: {type(e).__name__}: {e}", flush=True)
+    print("ALL DONE", flush=True)
+
+
+def _grid_section() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import tune_system
 
@@ -310,16 +333,6 @@ def main(quick: bool = False) -> None:
         (True, 16, 64, 0, 2, True),
     ], out="measure_tpu_grid.json",  # never clobber a full sweep's JSON
         inproc=True)
-
-    # --- 5. actor plane ---
-    from r2d2_tpu.bench import _actor_plane_bench
-
-    try:
-        print(f"actor plane: {_actor_plane_bench():,.0f} frames/s",
-              flush=True)
-    except Exception as e:
-        print(f"actor plane FAILED: {type(e).__name__}: {e}", flush=True)
-    print("ALL DONE", flush=True)
 
 
 if __name__ == "__main__":

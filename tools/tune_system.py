@@ -113,7 +113,7 @@ if __name__ == "__main__":
                                ("--slack", "cell_timeout_slack", float)):
         if _flag in _argv:
             _i = _argv.index(_flag)
-            if _i + 1 >= len(_argv):
+            if _i + 1 >= len(_argv) or _argv[_i + 1].startswith("--"):
                 sys.exit(f"usage: tune_system.py [seconds] [--short] "
                          f"[--out OUT.json] [--slack SECONDS] "
                          f"({_flag} needs a value)")

@@ -1,23 +1,23 @@
 #!/bin/bash
-# Round-4 recovery watcher: the moment the tunnel answers, capture the
-# on-chip numbers with ONLY bounded-subprocess measurements (bench.py
-# phase isolation + tune_system subprocess cells).  The in-process
-# battery (measure_tpu.py) is deliberately NOT run here: an in-process
-# wedge would hold the chip claim into the driver's round-end bench.
-# (tools/probe_then_measure.sh is the battery-running sibling for
-# interactive use — different payload, same probe/status protocol.)
+# Round-5 recovery watcher: the moment the tunnel answers, capture the
+# on-chip numbers.  Order: the driver-visible headline first (bench.py,
+# fully phase-isolated subprocess cells), then the decisive sweep cells
+# (tune_system --short, bounded subprocess cells), then the measurement
+# battery WITHOUT its in-process grid (--nogrid — the round-4 k=16 wedge
+# lived in a grid cell; sections 1-3b + actor plane are small internally
+# bounded cells that answer the Pallas-LSTM and fused-unroll questions).
 #
 # Probe cadence 300s with a 120s bound leaves ~180s idle between claim
 # attempts, so a recovered tunnel (or the driver's own bench) never
 # contends with a back-to-back probe child.
 cd /root/repo || exit 1
+mkdir -p artifacts/r05
 python tools/probe_loop.py 300 120 12 || { echo "{\"event\": \"watcher probe gave up $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl; exit 1; }
 echo "{\"event\": \"tunnel healthy — bench preview $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
-python bench.py > BENCH_r04_preview.json 2> BENCH_r04_preview.err
+python bench.py > artifacts/r05/BENCH_r05_preview.json 2> artifacts/r05/BENCH_r05_preview.err
 echo "{\"event\": \"bench preview rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
-# short sweep (tune_system.SHORT_GRID): only the three decisive cells,
-# tight per-cell bounds, so a late recovery can't hold the claim into
-# the driver's round-end bench (worst case ~27 min if every cell wedges)
-python tools/tune_system.py 120 --short --out tune_r04_recovered.json \
-    --slack 420 > tune_r04_recovered.log 2>&1
+python tools/tune_system.py 120 --short --out artifacts/r05/tune_r05_recovered.json \
+    --slack 420 > artifacts/r05/tune_r05_recovered.log 2>&1
 echo "{\"event\": \"sweep rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
+python tools/measure_tpu.py --nogrid > artifacts/r05/measure_tpu_r05.log 2>&1
+echo "{\"event\": \"battery rc=$? $(date +%H:%M:%S)\"}" >> tools/probe_status.jsonl
