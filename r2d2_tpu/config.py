@@ -209,9 +209,9 @@ class Config:
         if self.in_graph_per and not self.device_replay:
             raise ValueError("in_graph_per requires device_replay=True "
                              "(sampling reads the HBM-resident ring)")
-        if self.in_graph_per and self.device_ring_layout == "dp":
-            raise ValueError("in_graph_per requires a replicated ring "
-                             "layout (dp slabs sample on the host)")
+        # in_graph_per composes with every ring layout: replicated rings
+        # sample globally, dp-sharded rings sample per group slab inside
+        # shard_map (parallel/mesh.py sharded_in_graph_per_super_step)
         if self.device_ring_layout not in ("auto", "replicated", "dp"):
             raise ValueError(
                 f"unknown device_ring_layout {self.device_ring_layout!r}")

@@ -367,14 +367,7 @@ class Learner:
         the per-host device shards with zero data movement.
         """
         cfg = self.cfg
-        if cfg.in_graph_per and jax.process_count() > 1:
-            # fail HERE, not deep in sample_meta on an empty sum tree
-            # (in-graph mode never populates the host tree)
-            raise NotImplementedError(
-                "in_graph_per is single-process for now — multi-host "
-                "device replay samples per-host slabs through the host "
-                "tree (use in_graph_per=False)")
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and not cfg.in_graph_per:
             return self._run_device_multihost(buffer, ring, priority_sink,
                                               max_steps, stop, tracer)
         if tracer is None:
@@ -387,6 +380,8 @@ class Learner:
         updates = self.num_updates
         target = cfg.training_steps if max_steps is None else updates + max_steps
         if cfg.in_graph_per:
+            # single-process (any ring layout) AND multi-host (dp slabs):
+            # the drivetrain handles both — see its docstring
             return self._run_device_in_graph_per(buffer, ring, k, target,
                                                  t0, stop, tracer)
         # AOT-compile outside the buffer lock: the first dispatch happens
@@ -469,11 +464,33 @@ class Learner:
             return "go" if buffer.ready else "wait"
         return gate
 
+    def _collective_gate(self, buffer, stop):
+        """Multi-host gate(): the dispatch is a lockstep SPMD launch, so
+        the decision to make it must be collective.  One allgather
+        carries both flags (min-reduced, so "stop" travels inverted)."""
+        from r2d2_tpu.parallel.distributed import sync_min_array
+
+        def gate() -> str:
+            flags = sync_min_array(np.array([
+                0.0 if (stop is not None and stop()) else 1.0,
+                1.0 if buffer.ready else 0.0,
+            ]))
+            if flags[0] == 0.0:   # some host wants to stop
+                return "break"
+            if flags[1] == 0.0:   # some host's buffer not ready
+                return "wait"
+            return "go"
+        return gate
+
     def _finish_device_run(self, losses_hist, t0: float) -> Dict[str, float]:
         """Shared epilogue of the device drivetrains: final save + summary."""
         if self.checkpointer is not None:
             self._save(self.num_updates, t0)
         mins = self.start_minutes + (time.time() - t0) / 60.0
+        if jax.process_count() > 1:
+            from r2d2_tpu.parallel.distributed import sync_counter
+
+            self.env_steps = sync_counter(self.env_steps, reduce="sum")
         return dict(
             num_updates=self.num_updates,
             env_steps=self.env_steps,
@@ -500,22 +517,93 @@ class Learner:
         the ring's current handle and the returned one is stored back
         before the buffer lock is released, so actor block commits
         (``DeviceRing.commit_per``, same lock) always target the newest
-        generation.  Under a mesh the PER state replicates and the
-        sampled bundles are dp-constrained in-graph
-        (parallel/mesh.py:sharded_in_graph_per_super_step); multi-host
-        stays on the host-sampled path (per-host slabs)."""
+        generation.  Under a mesh: replicated rings keep the PER state
+        replicated with dp-constrained bundles; dp-sharded rings sample
+        per group slab inside shard_map — both via
+        parallel/mesh.py:sharded_in_graph_per_super_step.
+
+        Multi-host (ring layout "dp" over each host's local submesh, as
+        built by train.py): per dispatch the global ring + PER views are
+        stitched from the per-host device shards with zero data movement
+        (``assemble_global``), every process launches the same SPMD
+        super-step in lockstep (collective gate), and the returned
+        global priorities array — whose addressable shards are exactly
+        this host's slabs, updated in place — is relabelled back to the
+        local view and stored, so the host's actor commits keep writing
+        the newest generation.  The reference's priority feedback
+        (worker.py:242-276) at pod scale, with zero host round trips."""
         cfg = self.cfg
+        multihost = jax.process_count() > 1
+        layout = getattr(ring, "layout", "replicated")
         if self.mesh is not None:
             from r2d2_tpu.parallel.mesh import (
                 sharded_in_graph_per_super_step,
             )
 
             super_fn = sharded_in_graph_per_super_step(
-                cfg, self.net, self.mesh, k, state_template=self.state)
+                cfg, self.net, self.mesh, k, state_template=self.state,
+                layout=layout,
+                blocks_per_group=(ring.blocks_per_group
+                                  if layout == "dp" else None))
         else:
             from r2d2_tpu.learner.step import make_in_graph_per_super_step
 
             super_fn = make_in_graph_per_super_step(cfg, self.net, k)
+
+        if multihost:
+            from r2d2_tpu.parallel.distributed import (
+                assemble_global, local_mesh,
+            )
+            from r2d2_tpu.replay.device_ring import (
+                per_sharding, ring_sharding,
+            )
+
+            if layout != "dp":
+                raise RuntimeError(
+                    "multi-host in_graph_per needs a dp-layout ring "
+                    "(train.py builds one per host over its local "
+                    "submesh)")
+            K = cfg.seqs_per_block
+            bpg = ring.blocks_per_group
+            GB = self.mesh.shape["dp"] * bpg       # global slot count
+            gsh_ring = ring_sharding(self.mesh, "dp")
+            gsh_per = per_sharding(self.mesh, "dp")
+            lsh_prios = per_sharding(local_mesh(self.mesh), "dp")["prios"]
+            local_leaves = cfg.num_blocks * K
+
+            def ring_args():
+                """Global views of the per-host shards (metadata-only
+                stitch; caller holds the buffer lock)."""
+                meta = ring.per_meta()
+                per = assemble_global(
+                    {"seq_meta": gsh_per["seq_meta"],
+                     "first": gsh_per["first"]},
+                    {"seq_meta": meta["seq_meta"], "first": meta["first"]},
+                    GB)
+                prios_v = assemble_global(
+                    {"prios": gsh_per["prios"]},
+                    {"prios": ring.take_prios()}, GB * K)["prios"]
+                return (assemble_global(gsh_ring, ring.snapshot(), GB),
+                        prios_v, per["seq_meta"], per["first"])
+
+            def store_prios(new_global):
+                """Relabel the returned global priorities to this host's
+                local view — same device buffers, local coordinates —
+                so commit_per targets the newest generation."""
+                ring.put_prios(jax.make_array_from_single_device_arrays(
+                    (local_leaves,), lsh_prios,
+                    [s.data for s in new_global.addressable_shards]))
+
+            gate = self._collective_gate(buffer, stop)
+        else:
+            def ring_args():
+                meta = ring.per_meta()
+                return (ring.snapshot(), ring.take_prios(),
+                        meta["seq_meta"], meta["first"])
+
+            store_prios = ring.put_prios
+            gate = self._ready_gate(buffer, stop)
+
         seed0 = jnp.asarray(0, jnp.uint32)
         # AOT-compile from avals, not live ring handles: actor threads
         # are already committing blocks, and a concurrent commit_per
@@ -524,10 +612,7 @@ class Learner:
         # is snapshotted under the buffer lock; the lowering itself then
         # touches no device memory.
         with buffer.lock:
-            meta_h = ring.per_meta()
-            avals = _aval_tree(
-                (self.state, ring.snapshot(), ring.take_prios(),
-                 meta_h["seq_meta"], meta_h["first"], seed0))
+            avals = _aval_tree((self.state, *ring_args(), seed0))
         try:
             super_fn = super_fn.lower(*avals).compile()
         except Exception:
@@ -535,21 +620,21 @@ class Learner:
         compiled = super_fn
         losses_hist: deque = deque(maxlen=100)
         dispatch_no = [0]
-        gate = self._ready_gate(buffer, stop)
 
         def sample():
             with tracer.span("learner.step_dispatch"):
                 with buffer.lock:
                     # fold_in(PRNGKey(cfg.seed), idx) happens in-graph;
-                    # the u32 counter wraps harmlessly after 2^32
+                    # the u32 counter wraps harmlessly after 2^32.
+                    # Multi-host: every process dispatches in lockstep
+                    # (collective gate), so the counters — and with them
+                    # the in-graph sampling streams — stay identical.
                     idx = jnp.asarray(
                         dispatch_no[0] & 0xFFFFFFFF, jnp.uint32)
                     dispatch_no[0] += 1
-                    meta = ring.per_meta()
                     st, new_prios, losses = compiled(
-                        self.state, ring.snapshot(), ring.take_prios(),
-                        meta["seq_meta"], meta["first"], idx)
-                    ring.put_prios(new_prios)
+                        self.state, *ring_args(), idx)
+                    store_prios(new_prios)
                     env_steps = buffer.env_steps
             # losses ride the pipeline; priorities never leave the device
             return dict(dispatched=(st, losses, None),
@@ -686,7 +771,7 @@ class Learner:
 
         from r2d2_tpu.parallel.distributed import (
             assemble_global, global_from_local_rows, host_batch_size,
-            local_rows, owned_dp_groups, sync_counter, sync_min_array)
+            local_rows, owned_dp_groups, sync_min_array)
         from r2d2_tpu.parallel.mesh import sharded_super_step
         from r2d2_tpu.replay.device_ring import ring_sharding
 
@@ -757,19 +842,7 @@ class Learner:
             self._feed_back(meta, losses_np, prios_np, priority_sink,
                             losses_hist)
 
-        def gate() -> str:
-            # collective decisions: the dispatch below is an SPMD launch
-            # every process must make together.  One allgather carries
-            # both flags (min-reduced, so "stop" travels inverted).
-            flags = sync_min_array(np.array([
-                0.0 if (stop is not None and stop()) else 1.0,
-                1.0 if buffer.ready else 0.0,
-            ]))
-            if flags[0] == 0.0:   # some host wants to stop
-                return "break"
-            if flags[1] == 0.0:   # some host's buffer not ready
-                return "wait"
-            return "go"
+        gate = self._collective_gate(buffer, stop)
 
         def dispatch(ints, q):
             """Runs under the buffer lock (sample_meta couples sampling
@@ -797,18 +870,7 @@ class Learner:
 
         self._superstep_loop(k, target, t0, gate, sample, harvest,
                              prepare=prepare)
-
-        if self.checkpointer is not None:
-            self._save(self.num_updates, t0)
-        mins = self.start_minutes + (time.time() - t0) / 60.0
-        self.env_steps = sync_counter(self.env_steps, reduce="sum")
-        return dict(
-            num_updates=self.num_updates,
-            env_steps=self.env_steps,
-            minutes=mins,
-            mean_loss=(float(np.mean(losses_hist))
-                       if losses_hist else float("nan")),
-        )
+        return self._finish_device_run(losses_hist, t0)
 
     def _save(self, updates: int, t0: float) -> None:
         minutes = self.start_minutes + (time.time() - t0) / 60.0

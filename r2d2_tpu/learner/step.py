@@ -285,6 +285,38 @@ def _compensated_cumsum(x):
     return hi
 
 
+def _in_graph_sample_raw(cfg: Config, key, prios, seq_meta, first_burn,
+                         n_rows: int):
+    """``n_rows`` stratified proportional draws from a leaf slab:
+    (idx (n,), q (n,) f32 inclusion densities, ints (n, 6) i32).
+    The density q = prio/mass is the *raw* per-row inclusion
+    probability scale — the caller turns it into IS weights (min-
+    normalised over whatever scope it owns: the whole batch here, the
+    pod-wide batch in the grouped/multi-host samplers).  Host twin:
+    ``ReplayBuffer._grouped_densities`` (same q definition)."""
+    K, L = cfg.seqs_per_block, cfg.learning_steps
+    cum = _compensated_cumsum(prios)   # f64-accurate prefixes in f32
+    total = cum[-1]
+    targets = (jnp.arange(n_rows, dtype=jnp.float32)
+               + jax.random.uniform(key, (n_rows,))) * (total / n_rows)
+    idx = jnp.searchsorted(cum, targets, side="right")
+    idx = jnp.minimum(idx, prios.shape[0] - 1)
+    idx = jnp.where(prios[idx] > 0, idx, jnp.argmax(prios))
+    block_idx = idx // K
+    seq_idx = (idx % K).astype(jnp.int32)
+    meta = seq_meta[block_idx, seq_idx]                         # (n, 3)
+    burn = meta[:, 0]
+    start = first_burn[block_idx] + seq_idx * L
+    ints_t = jnp.stack(
+        [block_idx.astype(jnp.int32), start - burn, seq_idx, burn,
+         meta[:, 1], meta[:, 2]], axis=1)
+    # an all-zero slab (violates the ready-gate precondition) must not
+    # emit NaN densities — clamp to 1.0; the gathered rows are zero
+    # padding whose loss contribution the window masks bound anyway
+    q = jnp.where(total > 0, prios[idx] / total, 1.0)
+    return idx, q, ints_t
+
+
 def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
     """One prioritized batch draw on-device: (idx (B,), is_weights (B,)
     f32, ints (B, 6) i32).
@@ -302,24 +334,8 @@ def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
     normalisation cancels).  The ints bundle reproduces ``sample_meta``'s
     index arithmetic (replay_buffer.py:372-390) from the device-resident
     metadata, so ``gather_batch`` sees identical inputs either way."""
-    K, L = cfg.seqs_per_block, cfg.learning_steps
-    B = cfg.batch_size
-    cum = _compensated_cumsum(prios)   # f64-accurate prefixes in f32
-    total = cum[-1]
-    targets = (jnp.arange(B, dtype=jnp.float32)
-               + jax.random.uniform(key, (B,))) * (total / B)
-    idx = jnp.searchsorted(cum, targets, side="right")
-    idx = jnp.minimum(idx, prios.shape[0] - 1)
-    idx = jnp.where(prios[idx] > 0, idx, jnp.argmax(prios))
-    block_idx = idx // K
-    seq_idx = (idx % K).astype(jnp.int32)
-    meta = seq_meta[block_idx, seq_idx]                         # (B, 3)
-    burn = meta[:, 0]
-    start = first_burn[block_idx] + seq_idx * L
-    ints_t = jnp.stack(
-        [block_idx.astype(jnp.int32), start - burn, seq_idx, burn,
-         meta[:, 1], meta[:, 2]], axis=1)
-    q = prios[idx] / total
+    idx, q, ints_t = _in_graph_sample_raw(
+        cfg, key, prios, seq_meta, first_burn, cfg.batch_size)
     w = (q / q.min()) ** (-cfg.importance_sampling_exponent)
     return idx, w.astype(jnp.float32), ints_t
 

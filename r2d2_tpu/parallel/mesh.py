@@ -28,6 +28,7 @@ import math
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -284,36 +285,126 @@ def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
 def sharded_in_graph_per_super_step(cfg: Config, net: R2D2Network,
                                     mesh: Mesh, k: int,
                                     state_template: Optional[TrainState]
-                                    = None):
+                                    = None, layout: str = "replicated",
+                                    blocks_per_group: Optional[int] = None):
     """The device-PER super-step (learner/step.py:
     make_in_graph_per_super_step_fn) compiled over the mesh.
 
-    The PER state (priorities, sampling metadata) is tiny and replicated;
-    sampling executes identically on every device (same fold_in key →
-    same stratified draws), then the bundle's batch rows are
-    sharding-constrained to dp so GSPMD shards the gather and the
-    forward/backward exactly as the host-sampled path does.  Replicated
-    ring layout only (config validation forbids explicit 'dp' +
-    in_graph_per, and resolve_layout refuses to auto-shard under it: dp
-    slabs sample per group on the host)."""
+    ``layout="replicated"``: the PER state (priorities, sampling
+    metadata) is tiny and replicated; sampling executes identically on
+    every device (same fold_in key → same stratified draws), then the
+    bundle's batch rows are sharding-constrained to dp so GSPMD shards
+    the gather and the forward/backward exactly as the host-sampled path
+    does.
+
+    ``layout="dp"``: the ring AND the PER leaves shard their slot axis
+    over dp — capacity scales with the mesh, and sampling goes
+    per-group: inside ``shard_map``, dp group g draws its B/dp batch
+    rows from its own leaf slab (fold_in by ``axis_index("dp")`` gives
+    each group an independent stream), exactly the host dp path's
+    fixed-quota scheme (replay_buffer.sample_meta: priority-driven
+    *within* each slab, B/G rows per slab).  IS weights min-normalise
+    the raw inclusion densities across the WHOLE batch — ``jnp.min``
+    over the dp-sharded density rows, which GSPMD realises as the one
+    tiny cross-group collective in the data plane (on a multi-host mesh
+    this is the only PER traffic that crosses DCN).  Gather and priority
+    scatter run in per-group ``shard_map`` regions on local indices — no
+    collectives.  This is the composition the reference cannot express:
+    pod-scale replay capacity (train.py:23-26's 2M transitions and far
+    beyond) with zero host round trips in the priority loop.
+    """
     st_shard = _validate_mesh_step(cfg, mesh, state_template)
     from r2d2_tpu.learner.step import make_in_graph_per_super_step_fn
-    from r2d2_tpu.replay.device_ring import ring_sharding
+    from r2d2_tpu.replay.device_ring import per_sharding, ring_sharding
 
+    repl = replicated(mesh)
+    if layout == "replicated":
+        dp_rows = NamedSharding(mesh, P("dp"))
+
+        def constrain(ints_t, w_t):
+            return (jax.lax.with_sharding_constraint(ints_t, dp_rows),
+                    jax.lax.with_sharding_constraint(w_t, dp_rows))
+
+        fn = make_in_graph_per_super_step_fn(
+            cfg, _mesh_net(cfg, net, mesh), k, constrain=constrain)
+        return jax.jit(
+            fn,
+            in_shardings=(st_shard, ring_sharding(mesh, "replicated"),
+                          repl, repl, repl, repl),
+            out_shardings=(st_shard, repl, repl),
+            donate_argnums=(0, 2),
+        )
+    if layout != "dp":
+        raise ValueError(f"unknown in-graph PER layout {layout!r}")
+
+    from jax import shard_map
+
+    from r2d2_tpu.learner.step import _in_graph_sample_raw
+    from r2d2_tpu.replay.device_ring import gather_batch
+
+    dp = mesh.shape["dp"]
+    if blocks_per_group is None:
+        if cfg.num_blocks % dp:
+            raise ValueError(
+                f"layout='dp' needs num_blocks ({cfg.num_blocks}) "
+                f"divisible by dp={dp}")
+        blocks_per_group = cfg.num_blocks // dp
+    B = cfg.batch_size
+    Bg = B // dp
+    beta = cfg.importance_sampling_exponent
+    step = make_train_step(cfg, _mesh_net(cfg, net, mesh))
+    per_sh = per_sharding(mesh, "dp")
     dp_rows = NamedSharding(mesh, P("dp"))
 
-    def constrain(ints_t, w_t):
-        return (jax.lax.with_sharding_constraint(ints_t, dp_rows),
-                jax.lax.with_sharding_constraint(w_t, dp_rows))
+    def local_sample(key_t, p_g, meta_g, first_g):
+        gid = jax.lax.axis_index("dp")
+        idx, q, ints_t = _in_graph_sample_raw(
+            cfg, jax.random.fold_in(key_t, gid), p_g, meta_g, first_g, Bg)
+        return idx, q, ints_t
 
-    fn = make_in_graph_per_super_step_fn(cfg, _mesh_net(cfg, net, mesh), k,
-                                         constrain=constrain)
-    repl = replicated(mesh)
+    def local_gather(arrays_g, ints_g, w_g):
+        # sampled indices are already group-local — no offset to undo
+        return gather_batch(cfg, arrays_g, ints_g, w_g)
+
+    def local_scatter(p_g, idx_g, new_p_g):
+        return p_g.at[idx_g].set(new_p_g ** cfg.prio_exponent)
+
+    def super_step(state, arrays, prios, seq_meta, first_burn,
+                   dispatch_idx):
+        keys = jax.random.split(
+            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), dispatch_idx),
+            k)
+
+        def body(carry, key_t):
+            st, p = carry
+            idx, q, ints_t = shard_map(
+                local_sample, mesh=mesh,
+                in_specs=(P(), P("dp"), P("dp"), P("dp")),
+                out_specs=P("dp"))(key_t, p, seq_meta, first_burn)
+            # reference IS scheme across the WHOLE pod batch: one global
+            # min over the dp-sharded densities (the only collective in
+            # the PER loop), then w = (q/min)^-beta elementwise
+            w = ((q / jnp.min(q)) ** (-beta)).astype(jnp.float32)
+            batch = shard_map(
+                local_gather, mesh=mesh,
+                in_specs=(P("dp"), P("dp"), P("dp")),
+                out_specs=P("dp"))(arrays, ints_t, w)
+            st, loss, new_p = step(st, batch)
+            p = shard_map(
+                local_scatter, mesh=mesh,
+                in_specs=(P("dp"), P("dp"), P("dp")),
+                out_specs=P("dp"))(p, idx, new_p)
+            return (st, p), loss
+
+        (state, prios), losses = jax.lax.scan(body, (state, prios), keys)
+        return state, prios, losses
+
     return jax.jit(
-        fn,
-        in_shardings=(st_shard, ring_sharding(mesh, "replicated"),
-                      repl, repl, repl, repl),
-        out_shardings=(st_shard, repl, repl),
+        super_step,
+        in_shardings=(st_shard, ring_sharding(mesh, "dp"),
+                      per_sh["prios"], per_sh["seq_meta"],
+                      per_sh["first"], repl),
+        out_shardings=(st_shard, per_sh["prios"], repl),
         donate_argnums=(0, 2),
     )
 

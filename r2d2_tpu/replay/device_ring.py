@@ -152,6 +152,20 @@ def ring_sharding(mesh, layout: str = "replicated") -> Dict[str, Any]:
     return {k: sh for k in _DATA_KEYS}
 
 
+def per_sharding(mesh, layout: str = "replicated") -> Dict[str, Any]:
+    """Mesh shardings for the in-graph PER state: ``prios`` (NB*K,),
+    ``seq_meta`` (NB, K, 3), ``first`` (NB,).  Under ``layout="dp"`` all
+    three shard their leading (slot/leaf) axis over dp, aligned with the
+    ring slabs: group g's slots [g·bpg, (g+1)·bpg) own leaves
+    [g·bpg·K, (g+1)·bpg·K) — the flat leaf axis splits exactly at slab
+    boundaries because K divides each shard."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = (PartitionSpec("dp") if layout == "dp" else PartitionSpec())
+    sh = NamedSharding(mesh, spec)
+    return dict(prios=sh, seq_meta=sh, first=sh)
+
+
 def resolve_layout(cfg: Config, mesh, need_bytes: int,
                    cap_bytes: Optional[int]) -> str:
     """Resolve ``cfg.device_ring_layout`` to a concrete mesh layout.
@@ -185,15 +199,6 @@ def resolve_layout(cfg: Config, mesh, need_bytes: int,
         return "replicated"
     # "auto": replicate if it fits, shard if it must and can
     if can_dp and cap_bytes is not None and need_bytes > 0.8 * cap_bytes:
-        if getattr(cfg, "in_graph_per", False):
-            # dp slabs sample on the host — incompatible with device PER.
-            # Fail HERE with the remedy, not at ring construction.
-            raise ValueError(
-                f"in_graph_per needs a replicated ring, but the ring "
-                f"({need_bytes / 1e9:.1f} GB) exceeds one device's HBM "
-                f"budget ({0.8 * cap_bytes / 1e9:.1f} GB) — shrink "
-                "buffer_capacity, or set in_graph_per=False to allow "
-                "the dp-sharded layout")
         return "dp"
     return "replicated"
 
@@ -263,27 +268,40 @@ class DeviceRing:
         # Leaf priorities (td**alpha; 0 = never-sampleable) plus the
         # per-sequence window metadata the in-graph sampler needs to
         # build index bundles without the host (learner/step.py
-        # _in_graph_sample).  Replicated under a mesh (tiny arrays).
+        # _in_graph_sample).  Replicated under a mesh; dp layout shards
+        # the leaf axis with the ring slabs (per_sharding).
         # The priorities handle is READ-WRITE from the learner's super
         # step (donated carry) AND written by actor block commits —
         # both sides mutate it only under the module's coordinating
         # lock, via take_prios()/put_prios() and commit_per().
         self._per_write = None
         if getattr(cfg, "in_graph_per", False):
-            if self.num_groups > 1:
-                raise ValueError(
-                    "in_graph_per currently requires a replicated ring "
-                    "(device_ring_layout='dp' samples per group slab on "
-                    "the host)")
             K = cfg.seqs_per_block
-            self._per_prios = self._put_slot(
-                np.zeros((NB * K,), np.float32))
-            self._per_seq_meta = self._put_slot(
-                np.zeros((NB, K, 3), np.int32))
-            self._per_first = self._put_slot(np.zeros((NB,), np.int32))
-            self._per_write = jax.jit(
-                functools.partial(_write_per_fn, K=K),
-                donate_argnums=(0, 1, 2))
+            if self.num_groups > 1:
+                # dp layout: the PER leaves shard with the ring slabs —
+                # the grouped in-graph sampler draws each group's rows
+                # from its own slab shard (parallel.mesh, layout="dp")
+                psh = per_sharding(mesh, "dp")
+                self._per_prios = jax.device_put(
+                    np.zeros((NB * K,), np.float32), psh["prios"])
+                self._per_seq_meta = jax.device_put(
+                    np.zeros((NB, K, 3), np.int32), psh["seq_meta"])
+                self._per_first = jax.device_put(
+                    np.zeros((NB,), np.int32), psh["first"])
+                self._per_write = jax.jit(
+                    functools.partial(_write_per_fn, K=K),
+                    donate_argnums=(0, 1, 2),
+                    out_shardings=(psh["prios"], psh["seq_meta"],
+                                   psh["first"]))
+            else:
+                self._per_prios = self._put_slot(
+                    np.zeros((NB * K,), np.float32))
+                self._per_seq_meta = self._put_slot(
+                    np.zeros((NB, K, 3), np.int32))
+                self._per_first = self._put_slot(np.zeros((NB,), np.int32))
+                self._per_write = jax.jit(
+                    functools.partial(_write_per_fn, K=K),
+                    donate_argnums=(0, 1, 2))
 
     def _put(self, x):
         return (jax.device_put(x, self._placement)

@@ -107,6 +107,16 @@ class ReplayBuffer:
         self.cfg = cfg
         self.action_dim = action_dim
         self.device_ring = device_ring
+        if getattr(cfg, "in_graph_per", False) and device_ring is None:
+            # fail HERE with the remedy, not with an AttributeError in an
+            # actor thread at the first block commit: device PER cannot
+            # run on the host-staged fallback (the host tree is never
+            # populated and the priority loop is stripped, train.py)
+            raise ValueError(
+                "in_graph_per requires a device ring, but none was built "
+                "— the ring did not fit the device budget or the "
+                "multi-host shape checks failed (see the warning above); "
+                "shrink buffer_capacity or set in_graph_per=False")
 
         # Slot groups (dp-sharded device ring): the ring's slot axis is
         # partitioned into G contiguous slabs, one per dp mesh group.  The
@@ -120,6 +130,10 @@ class ReplayBuffer:
                   if device_ring is not None else 1)
         assert cfg.num_blocks % self.G == 0  # DeviceRing validated this
         self._blocks_per_group = cfg.num_blocks // self.G
+        # in-graph PER + dp slabs: host-side record of which slabs have
+        # ever received a block with positive mass (the `ready` gate —
+        # the host tree stays empty in that mode)
+        self._group_filled = np.zeros(self.G, bool)
 
         spec = _count_spec(cfg) if device_ring is not None else _ring_spec(
             cfg, action_dim)
@@ -175,6 +189,12 @@ class ReplayBuffer:
             # per-group sampling needs every slab non-empty; round-robin
             # fill reaches all slabs within the first G blocks, long before
             # any realistic learning_starts, but guard the degenerate case.
+            if getattr(self.cfg, "in_graph_per", False):
+                # priorities live on-device (the host tree stays empty):
+                # gate on the host-side ever-filled record instead — a
+                # slab counts filled once a block with positive mass
+                # landed in it (add() below)
+                return bool(self._group_filled.all())
             # Unlike the GIL-atomic `size` read above, the mass walk spans
             # many tree nodes — take the lock so a concurrent update's
             # level-order repair can't produce a torn (spuriously positive)
@@ -224,6 +244,8 @@ class ReplayBuffer:
                 # priorities live on-device; the host tree stays empty
                 self.device_ring.commit_per(slot, prios_alpha, meta,
                                             int(block.burn_in_steps[0]))
+                if prios_alpha.max() > 0:
+                    self._group_filled[slot // self._blocks_per_group] = True
             else:
                 leaf_idxes = np.arange(slot * K, (slot + 1) * K,
                                        dtype=np.int64)

@@ -8,11 +8,17 @@ concurrently with the learner's collectives, which deadlocks the pod if
 any published leaf is a global-mesh array (regression: Learner._publish
 must hand actors process-local arrays).
 
-Usage: python _mp_train_worker.py <port> <process_id> <out_json> [device_replay]
+Usage: python _mp_train_worker.py <port> <process_id> <out_json>
+           [device_replay] [in_graph_per]
 
 ``device_replay`` (default "1"): "0" runs the host-staged multi-host data
 plane (Learner.run with host_local_batch) instead — the same actor/publish
 concurrency, different learner loop.
+
+``in_graph_per`` (default "0"): "1" runs the device-resident PER
+drivetrain over the per-host dp slabs (Learner._run_device_in_graph_per
+multi-host: stitched global PER views, lockstep SPMD dispatches, zero
+host priority traffic).
 """
 import json
 import os
@@ -24,6 +30,7 @@ jax.config.update("jax_platforms", "cpu")
 
 PORT, PID, OUT = sys.argv[1], int(sys.argv[2]), sys.argv[3]
 DEVICE_REPLAY = (sys.argv[4] if len(sys.argv) > 4 else "1") == "1"
+IN_GRAPH_PER = (sys.argv[5] if len(sys.argv) > 5 else "0") == "1"
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import faulthandler  # noqa: E402
@@ -43,6 +50,7 @@ from r2d2_tpu.envs.fake import FakeAtariEnv  # noqa: E402
 from r2d2_tpu.train import train  # noqa: E402
 
 cfg = test_config(game_name="Fake", device_replay=DEVICE_REPLAY,
+                  in_graph_per=IN_GRAPH_PER,
                   superstep_k=2,
                   superstep_pipeline=2,  # multihost pipelined harvest +
                                          # exit drain must stay deadlock-free

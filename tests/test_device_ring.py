@@ -460,12 +460,11 @@ def test_resolve_layout():
     with pytest.raises(ValueError, match="mesh"):
         resolve_layout(cfg.replace(device_ring_layout="dp"), None,
                        GB, 16 * GB)
-    # auto + in_graph_per: would shard → refuse with the remedy instead
-    # (dp slabs sample on the host; device PER needs a replicated ring)
+    # auto + in_graph_per: shards exactly like the host-PER ring — the
+    # grouped in-graph sampler handles dp slabs (parallel/mesh.py)
     cfg_ig = make_cfg(mesh_shape=(("dp", 4),), device_replay=True,
                       in_graph_per=True)
-    with pytest.raises(ValueError, match="in_graph_per"):
-        resolve_layout(cfg_ig, mesh, 15 * GB, 16 * GB)
+    assert resolve_layout(cfg_ig, mesh, 15 * GB, 16 * GB) == "dp"
     assert resolve_layout(cfg_ig, mesh, GB, 16 * GB) == "replicated"
 
 

@@ -131,3 +131,21 @@ def test_two_process_full_train(tmp_path, device_replay):
                                                 rel=1e-6)
     # env_steps were sync-summed across hosts at exit — both agree
     assert res[0]["env_steps"] == res[1]["env_steps"] > 0
+
+
+def test_two_process_in_graph_per_train(tmp_path):
+    """The device-PER drivetrain at pod scale: 2 processes, per-host dp
+    ring slabs with device-resident priorities, sampling/scatter inside
+    the lockstep SPMD super-step (Learner._run_device_in_graph_per
+    multi-host).  The priority loop crosses neither the host boundary
+    nor DCN (only the one IS-weight min collective does) — the
+    reference's feedback path (worker.py:242-276) with zero round
+    trips, composed with pod-scale replay capacity."""
+    res = _spawn_workers(_TRAIN_WORKER, tmp_path, 540, 1, 1)
+    for i, r in enumerate(res):
+        assert not r["fabric_failed"], f"host {i} fabric failed"
+        assert r["num_updates"] >= 8
+        assert r["loss_finite"]
+    assert res[0]["mean_loss"] == pytest.approx(res[1]["mean_loss"],
+                                                rel=1e-6)
+    assert res[0]["env_steps"] == res[1]["env_steps"] > 0
