@@ -61,15 +61,20 @@ def _peak_tflops(kind: str) -> float:
     return 0.0
 
 
-def _learner_micro_bench(steps: int, warmup: int):
-    """(frames/s, steps/s, flops_per_step_or_0) for the flagship step."""
+def _learner_micro_bench(steps: int, warmup: int, fused: bool = False):
+    """(frames/s, steps/s, flops_per_step_or_0) for the flagship step.
+
+    ``fused=True`` times the same step with ``fused_double_unroll`` — the
+    single double-batch online+target unroll (learner/step.py) — so the
+    feature's value is a measured train-step cell, not an extrapolation
+    from the B=64/B=128 unroll ratio."""
     import jax
 
     from r2d2_tpu.config import Config
     from r2d2_tpu.learner.step import create_train_state, jit_train_step
     from r2d2_tpu.models.network import create_network, init_params
 
-    cfg = Config()
+    cfg = Config(fused_double_unroll=fused)
     action_dim = 9  # MsPacman minimal action set
     net = create_network(cfg, action_dim)
     params = init_params(cfg, net, jax.random.PRNGKey(0))
@@ -335,6 +340,7 @@ def _phase_main(argv) -> int:
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--seconds", type=float, default=75.0)
     p.add_argument("--knobs", type=str, default="{}")
+    p.add_argument("--fused", action="store_true")
     a = p.parse_args(argv)
 
     from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
@@ -343,7 +349,8 @@ def _phase_main(argv) -> int:
     if a.phase == "micro":
         import jax
 
-        fps, sps, flops = _learner_micro_bench(a.steps, a.warmup)
+        fps, sps, flops = _learner_micro_bench(a.steps, a.warmup,
+                                               fused=a.fused)
         d = jax.devices()[0]
         out = dict(learner_fps=fps, steps_per_sec=sps, flops=flops,
                    platform=d.platform,
@@ -375,6 +382,13 @@ def _main_isolated(steps: int, warmup: int, system_seconds: float) -> None:
     # must not be misreported as a wedge
     micro, m_err = _run_phase("micro", 900.0 + (steps + warmup) * 1.0,
                               ("--steps", steps, "--warmup", warmup))
+    # the same micro cell through the fused double unroll (one
+    # double-batch online+target pass): the feature's measured value,
+    # reported side by side with the two-unroll headline
+    micro_fused, mf_err = _run_phase(
+        "micro", 900.0 + (steps + warmup) * 1.0,
+        ("--steps", steps, "--warmup", warmup, "--fused"),
+        label="micro_fused")
     system, s_err = _run_phase(
         "system", system_seconds + 900.0,
         ("--seconds", system_seconds, "--knobs", json.dumps(system_knobs)))
@@ -399,11 +413,14 @@ def _main_isolated(steps: int, warmup: int, system_seconds: float) -> None:
         "system_knobs": system_knobs,
         "system_ingraph_env_frames_per_sec": (
             round(system_ig["system_fps"], 1) if system_ig else -1.0),
+        "learner_fused_env_frames_per_sec": (
+            round(micro_fused["learner_fps"], 1) if micro_fused else -1.0),
         "actor_env_frames_per_sec": (round(actor["actor_fps"], 1)
                                      if actor else -1.0),
         "host_cpus": os.cpu_count() or 0,
     }
     errors = {k: v for k, v in (("micro", m_err), ("system", s_err),
+                                ("micro_fused", mf_err),
                                 ("system_ingraph", ig_err),
                                 ("actor", a_err)) if v}
     if errors:
@@ -481,6 +498,11 @@ def main(steps: int = 100, warmup: int = 5,
     # failure instead of taking the whole artifact down.
     learner_fps, steps_per_sec, flops = _learner_micro_bench(steps, warmup)
     try:
+        fused_fps, _, _ = _learner_micro_bench(steps, warmup, fused=True)
+    except Exception:
+        traceback.print_exc()
+        fused_fps = -1.0
+    try:
         actor_fps = _actor_plane_bench()
     except Exception:
         traceback.print_exc()
@@ -514,6 +536,7 @@ def main(steps: int = 100, warmup: int = 5,
         # artifact documents what was measured
         "system_knobs": system_knobs,
         "system_ingraph_env_frames_per_sec": round(system_ig_fps, 1),
+        "learner_fused_env_frames_per_sec": round(fused_fps, 1),
         "actor_env_frames_per_sec": round(actor_fps, 1),
         # the actor/system planes are host-CPU-bound work: their numbers
         # only compare across machines with this context attached

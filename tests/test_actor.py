@@ -46,6 +46,7 @@ def test_agent_state_carrier():
     np.testing.assert_array_equal(st.hidden, hidden)
 
 
+@pytest.mark.slow
 def test_actor_produces_wellformed_blocks():
     cfg = make_test_config(game_name="Fake")
     net, params, store, act_fn = build(cfg)

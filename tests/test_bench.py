@@ -4,6 +4,8 @@ the round artifact — a late-phase crash must not zero it)."""
 import json
 import sys
 
+import pytest
+
 import numpy as np
 
 
@@ -109,7 +111,8 @@ def test_isolated_bench_headline_failure_exits_nonzero(monkeypatch, capsys):
     assert ex.value.code == 1
     result = json.loads(capsys.readouterr().out.strip().splitlines()[0])
     assert result["value"] == -1.0
-    assert set(result["phase_errors"]) == {"micro", "system",
+    assert set(result["phase_errors"]) == {"micro", "micro_fused",
+                                           "system",
                                            "system_ingraph", "actor"}
 
 
@@ -146,6 +149,7 @@ def test_run_phase_parses_last_json_line(monkeypatch):
     assert res is None and "rc=3" in err
 
 
+@pytest.mark.slow
 def test_actor_plane_bench_fleet_split_counts_all_lanes(monkeypatch):
     """The fleets/env_workers/act_device knobs (tools/actor_scaling.py's
     sweep surface) must keep the frames accounting exact: every lane lands

@@ -110,6 +110,7 @@ def test_sample_batch_raises_on_device_buffer():
         dev.sample_batch(4)
 
 
+@pytest.mark.slow
 def test_super_step_equals_sequential_steps():
     """k fused steps (scan + in-graph gather) must reproduce k sequential
     jit_train_step calls on host-assembled batches: same params, same
@@ -152,6 +153,7 @@ def test_super_step_equals_sequential_steps():
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_train_end_to_end_with_device_replay():
     """The full threaded fabric on the device data plane: updates advance,
     loss is finite, priority feedback reaches the buffer."""
@@ -170,6 +172,7 @@ def test_train_end_to_end_with_device_replay():
     assert not metrics["fabric_failed"]
 
 
+@pytest.mark.slow
 def test_sharded_super_step_matches_single_device():
     """The mesh-compiled super-step (replicated ring, dp-sharded index
     bundles, GSPMD grad psums) must reproduce the single-device super-step
@@ -212,6 +215,7 @@ def test_sharded_super_step_matches_single_device():
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_train_end_to_end_device_replay_under_mesh():
     """Full fabric: device plane + mesh (single process) trains."""
     from r2d2_tpu.train import train
@@ -291,6 +295,7 @@ def test_dp_sample_meta_rejects_indivisible_batch():
         buf.sample_meta(k=1, batch_size=6)
 
 
+@pytest.mark.slow
 def test_dp_sharded_super_step_matches_single_device():
     """The dp-sharded data plane (slot-sharded ring, shard_map gather) must
     reproduce the single-device super-step on the same index bundles —
@@ -468,6 +473,7 @@ def test_resolve_layout():
     assert resolve_layout(cfg_ig, mesh, GB, 16 * GB) == "replicated"
 
 
+@pytest.mark.slow
 def test_train_end_to_end_device_replay_dp_layout():
     """Full fabric on the dp-sharded device data plane."""
     from r2d2_tpu.train import train
@@ -485,6 +491,7 @@ def test_train_end_to_end_device_replay_dp_layout():
     assert not metrics["fabric_failed"]
 
 
+@pytest.mark.slow
 def test_device_replay_falls_back_to_host_when_ring_too_big(monkeypatch):
     """The capacity guard must degrade to host replay with a warning, not
     crash or silently OOM, when the ring exceeds the device budget."""
@@ -545,6 +552,7 @@ def test_run_device_cadences_and_drain(tmp_path):
     assert 12 in ck.steps() and len(ck.steps()) >= 2
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("depth", [0, 3])
 def test_run_device_pipeline_depths(depth):
     """The super-step pipeline must deliver every dispatched sub-batch's

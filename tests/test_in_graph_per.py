@@ -10,6 +10,7 @@ run with zero host priority traffic.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from r2d2_tpu.config import test_config as make_test_config
 from r2d2_tpu.learner.step import (
@@ -179,6 +180,7 @@ def test_in_graph_super_step_trains_and_scatters_feedback():
     assert (p1[p0 == 0] == 0).all()
 
 
+@pytest.mark.slow
 def test_in_graph_scatter_writes_host_equivalent_priorities():
     """The in-scan priority scatter must write exactly what the host
     feedback path would: td**alpha of the mixed-TD priorities the train
@@ -224,6 +226,7 @@ def test_in_graph_scatter_writes_host_equivalent_priorities():
                                rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_in_graph_per_sharded_matches_single_device():
     """dp=8 mesh device-PER super-step == single-device: same losses,
     same scattered priorities, same params (sampling is deterministic
@@ -263,6 +266,7 @@ import pytest
 
 
 @pytest.mark.parametrize("fused", [False, True])
+@pytest.mark.slow
 def test_train_end_to_end_in_graph_per(fused):
     """Full threaded fabric with device PER, at both loss paths (the
     default two-unroll and the fused double unroll — orthogonal
@@ -367,6 +371,7 @@ def test_in_graph_sample_raw_matches_host_per_slab():
         np.testing.assert_allclose(q, p_g[idx] / p_g.sum(), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_in_graph_per_dp_super_step_trains_and_guards_padding():
     """The dp-layout device-PER super-step (per-slab shard_map sampling,
     parallel/mesh.py): finite losses, params advance, and the priority
@@ -405,6 +410,7 @@ def test_in_graph_per_dp_super_step_trains_and_guards_padding():
     assert moved
 
 
+@pytest.mark.slow
 def test_train_end_to_end_in_graph_per_dp_layout():
     """Full threaded fabric: device PER over a dp-sharded ring on a
     dp=4 x mp=2 mesh — the capacity-scaling composition (pod-size

@@ -126,6 +126,7 @@ def test_loss_and_priorities_match_reference_oracle():
     np.testing.assert_allclose(np.asarray(prios), exp_prios, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fused_double_unroll_matches_unfused():
     """cfg.fused_double_unroll (one vmapped unroll over stacked
     online+target params) must be a pure scheduling change: identical

@@ -82,6 +82,7 @@ def test_t1_unroll_acting_shape(inputs):
     np.testing.assert_allclose(cT, cT_o, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_network_pallas_matches_scan_end_to_end():
     """Full R2D2Network with impl=pallas (interpreted) vs impl=scan: same
     params → same q and matching parameter gradients, proving the two

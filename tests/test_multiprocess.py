@@ -17,6 +17,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # 2-process runtimes: ~70-90 s each
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
 _TRAIN_WORKER = os.path.join(os.path.dirname(__file__),
                              "_mp_train_worker.py")

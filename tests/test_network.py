@@ -37,6 +37,7 @@ def test_unroll_shapes():
     assert new_hidden.shape == hidden.shape
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("torso", ["nature", "impala"])
 def test_conv_torsos(torso):
     cfg = make_test_config(obs_shape=(84, 84, 1), torso=torso, hidden_dim=32)
@@ -59,6 +60,7 @@ def test_multi_layer_lstm():
     assert not np.allclose(np.asarray(new_hidden), np.asarray(hidden))
 
 
+@pytest.mark.slow
 def test_act_matches_unroll_stepwise():
     """Feeding T steps one at a time through ``act`` (chaining hidden) must
     equal one ``unroll`` — validates scan correctness and the state format."""

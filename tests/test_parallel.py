@@ -59,6 +59,7 @@ def test_make_mesh_custom_shape_and_errors():
                            make_mesh(make_test_config()))
 
 
+@pytest.mark.slow
 def test_sharded_step_matches_single_device():
     """dp=8 GSPMD step must reproduce the single-device step: same loss,
     priorities, and updated params (the semantics-preservation contract of
@@ -85,6 +86,7 @@ def test_sharded_step_matches_single_device():
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_fused_double_unroll_sharded_matches_single_device():
     """The fused online+target unroll (vmap over stacked params) must
     survive GSPMD partitioning: dp=8 fused step == single-device fused
@@ -116,6 +118,7 @@ def test_fused_double_unroll_sharded_matches_single_device():
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sharded_multistep_stays_in_sync():
     """Run 3 sharded steps (with in-graph target sync crossing its cadence)
     and compare against 3 single-device steps."""
@@ -143,6 +146,7 @@ def test_sharded_multistep_stays_in_sync():
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_mp_sharded_step_matches_single_device():
     """2-D (dp=4, mp=2) mesh: kernels shard over mp, batch over dp; the
     result must still match the single-device step exactly."""
@@ -188,6 +192,7 @@ def test_mp_mesh_requires_state_template():
         sharded_train_step(cfg, net, make_mesh(cfg))
 
 
+@pytest.mark.slow
 def test_pallas_spmd_sharded_step_matches_scan():
     """lstm_impl='pallas_spmd': the fused kernel runs per-device inside
     shard_map over dp (interpret mode on this CPU mesh) and must reproduce

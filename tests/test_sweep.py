@@ -5,6 +5,7 @@ import os
 from r2d2_tpu.config import test_config as make_test_config
 from r2d2_tpu.envs.fake import FakeAtariEnv
 from r2d2_tpu.sweep import ATARI_57, run_sweep
+import pytest
 
 
 def env_factory(cfg, seed):
@@ -17,6 +18,7 @@ def test_atari57_list_is_57_games():
     assert len(set(ATARI_57)) == 57
 
 
+@pytest.mark.slow
 def test_sweep_two_games_and_resume(tmp_path):
     cfg = make_test_config(training_steps=6, save_interval=3,
                            eval_episodes=2, max_episode_steps=12)
@@ -43,6 +45,7 @@ def test_sweep_two_games_and_resume(tmp_path):
     assert summary2 == summary
 
 
+@pytest.mark.slow
 def test_sweep_reenters_partially_trained_game(tmp_path):
     """A game cut short (e.g. by max_wall_seconds_per_game) records its
     partial num_updates and must re-enter training on the next sweep run

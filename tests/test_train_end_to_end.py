@@ -26,6 +26,7 @@ def env_factory(cfg, seed):
                         episode_len=32)
 
 
+@pytest.mark.slow
 def test_train_sync_learns():
     """The CI-able smoke run: fill past learning_starts, take 150+ updates,
     loss finite and decreasing, episode returns logged."""
@@ -42,6 +43,7 @@ def test_train_sync_learns():
     assert m["env_steps"] >= cfg.learning_starts
 
 
+@pytest.mark.slow
 def test_train_threaded_fabric():
     """The concurrent fabric: all planes (actor ingest / sampling / learner /
     priority feedback / logging) overlap and the run terminates cleanly."""
@@ -55,6 +57,7 @@ def test_train_threaded_fabric():
     assert len(m["logs"]) > 0  # stats loop produced entries
 
 
+@pytest.mark.slow
 def test_train_long_context_impala_deep_composition():
     """The seq-120 flagship composition (BASELINE configs[4]) at test
     scale: IMPALA torso + 2-layer LSTM + remat over windows ~3x the
@@ -99,6 +102,7 @@ class _FlakyEnv:
         return self._env.step(a)
 
 
+@pytest.mark.slow
 def test_fabric_recovers_from_actor_crash():
     """An env exception kills the actor thread mid-run; the Supervisor must
     restart it (crash recorded in health) and the run must still complete
@@ -140,6 +144,7 @@ def _scripted_batches(cfg, n, seed=0):
     return out
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_bit_exact(tmp_path):
     """Kill/restart resumes bit-exact (VERDICT r1 item 6): 6 updates with a
     checkpoint at 3, restart from the checkpoint, replay updates 4-6 → same
@@ -181,6 +186,7 @@ def test_checkpoint_resume_bit_exact(tmp_path):
         np.testing.assert_array_equal(np.asarray(p_full), np.asarray(p_res))
 
 
+@pytest.mark.slow
 def test_evaluate_sweep_produces_curve(tmp_path):
     """Checkpoint sweep → learning-curve records (reference test.py:14-58)."""
     ck_dir = os.path.join(tmp_path, "ck")
@@ -200,6 +206,7 @@ def test_evaluate_sweep_produces_curve(tmp_path):
     assert os.path.exists(out_json)
 
 
+@pytest.mark.slow
 def test_trained_policy_beats_random():
     """After training, the greedy policy must beat a random policy on the
     fake env (quality regression gate, not just loss plumbing)."""
@@ -242,6 +249,7 @@ def test_host_staged_run_pipeline_depths(depth):
     assert np.isfinite(metrics["mean_loss"])
 
 
+@pytest.mark.slow
 def test_train_threaded_fabric_multi_fleet():
     """actor_fleets > 1: lanes split into independent fleet threads with
     GLOBAL ladder epsilons; the fabric trains and every fleet contributes

@@ -8,7 +8,7 @@ bench — no reimplementation to drift) over a grid of ``env_workers``
 lockstep fleets, train.py's actor_fleets split).
 
 Default run is CPU-pinned and writes the host-scaling table to
-ACTOR_SCALING_r04.json.  ``--device`` leaves the default backend alone
+artifacts/r05/ACTOR_SCALING_r05.json.  ``--device`` leaves the default backend alone
 and measures ONLY the act_device cells (CPU twin vs on-device acting),
 merging them into the existing artifact instead of re-measuring — and
 overwriting — the CPU-pinned table with a different backend active.
@@ -30,7 +30,7 @@ import jax  # noqa: E402
 from r2d2_tpu.bench import _actor_plane_bench  # noqa: E402
 
 ITERS = 300
-PATH = "ACTOR_SCALING_r04.json"
+PATH = "artifacts/r05/ACTOR_SCALING_r05.json"
 
 
 def cell(env_workers: int, fleets: int, act_device: str = "auto") -> dict:
