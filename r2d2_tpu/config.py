@@ -133,12 +133,14 @@ class Config:
                                       # dispatch and the k inner steps see
                                       # fresh priorities (the host path's
                                       # feedback lags >= k updates).
-                                      # Requires device_replay, replicated
-                                      # ring layout.  Off by default only
-                                      # because the r4 outage prevented
-                                      # on-chip timing; CPU-measured 2.2x
-                                      # the host path with learning parity
-                                      # on all three network families
+                                      # Requires device_replay; composes
+                                      # with replicated AND dp-sharded
+                                      # rings, single- and multi-host.
+                                      # Default False only for the plain
+                                      # constructor (host-replay users);
+                                      # the device-replay learning presets
+                                      # turn it ON — see pong_config's
+                                      # rationale
     fused_double_unroll: bool = False  # compute the online+target forwards
                                       # as ONE unroll vmapped over stacked
                                       # params: half the sequential LSTM
@@ -273,19 +275,32 @@ def pong_config(**kw) -> Config:
     staging, worker.py:300-316).  k=16 (lag 48) showed a measurable
     late-curve tax in the 4-run fabric A/B (CURVES_AB_PIPELINE_r04*:
     late-mean 22.9 vs 27.7 baseline, k=4 at parity 26.1); k=16 remains a
-    throughput-bench knob, not a learning default."""
+    throughput-bench knob, not a learning default.
+
+    in_graph_per=True (flipped r5): the CPU A/B measured 2.2× the
+    host-sampled update rate at learning parity (2 seeds × 3 network
+    families, CURVES_*_INGRAPH_r04, 60-min soak SOAK_INGRAPH_LONG_r04)
+    — and CPU is the feature's WORST case: it removes a per-harvest
+    host round trip (~99 ms on the tunneled chip, MEASURE_TPU_r04.md
+    learner.result_sync) that costs ~nothing on CPU, so the on-chip win
+    is bounded below by the CPU win.  bench.py reports the host-path and
+    in-graph cells side by side (system_env_frames_per_sec vs
+    system_ingraph_env_frames_per_sec) so every round's artifact
+    re-checks this choice on real hardware."""
     base = dict(game_name="Pong", num_actors=64, env_workers=8,
-                device_replay=True, superstep_k=4, superstep_pipeline=2)
+                device_replay=True, in_graph_per=True,
+                superstep_k=4, superstep_pipeline=2)
     base.update(kw)
     return Config(**base)
 
 
 def hard_exploration_config(game: str = "MontezumaRevenge", **kw) -> Config:
-    """configs[2]: hard-exploration Atari, 256 actors.  superstep_k=4:
-    see pong_config's lag rationale (CURVES_AB_PIPELINE_r04*)."""
+    """configs[2]: hard-exploration Atari, 256 actors.  superstep_k=4 and
+    in_graph_per=True: see pong_config's rationale."""
     base = dict(game_name=game, num_actors=256, env_workers=16,
                 actor_fleets=4,
-                device_replay=True, superstep_k=4, superstep_pipeline=2)
+                device_replay=True, in_graph_per=True,
+                superstep_k=4, superstep_pipeline=2)
     base.update(kw)
     return Config(**_clamp_fleets(base, kw))
 
