@@ -120,7 +120,7 @@ def make_act_fn(cfg: Config, net: R2D2Network):
     platform = (act_dev.platform if act_dev is not None
                 else jax.default_backend())
     twin = {}
-    if (resolve_lstm_impl(cfg) in ("pallas", "pallas_spmd")
+    if (resolve_lstm_impl(cfg) == "pallas"
             and not cfg.pallas_interpret and platform != "tpu"):
         twin["lstm_impl"] = "scan"
     if platform == "cpu" and cfg.compute_dtype == "bfloat16":

@@ -85,11 +85,14 @@ class Config:
     compute_dtype: str = "bfloat16"   # activations dtype for conv/matmul
     param_dtype: str = "float32"
     remat: bool = False               # rematerialise the LSTM scan (long seq)
-    lstm_impl: str = "auto"           # "auto" | "scan" | "pallas" (ops/lstm.py)
-                                      # | "pallas_spmd" (the fused kernel
-                                      # under dp meshes via shard_map —
-                                      # explicit opt-in; "auto" meshes use
-                                      # the scan recurrence)
+    lstm_impl: str = "auto"           # "auto" | "scan" | "pallas": the
+                                      # recurrence for NO-GRAD paths
+                                      # (acting/eval).  Training always
+                                      # runs the scan (the Pallas backward
+                                      # kernel was retired in r5 — on-chip
+                                      # it measured 0.96x scan; the fused
+                                      # kernel keeps its 1.07x inference
+                                      # edge, ops/lstm.py)
     pallas_interpret: bool = False    # run pallas kernels interpreted (CPU tests)
     mesh_shape: Tuple[Tuple[str, int], ...] = ()  # e.g. (("dp", 4), ("mp", 2))
     prefetch_batches: int = 4         # reference staging list depth, worker.py:312
@@ -223,9 +226,10 @@ class Config:
             raise ValueError(f"unknown torso {self.torso!r}")
         if self.lstm_layers < 1:
             raise ValueError("lstm_layers must be >= 1")
-        if self.lstm_impl not in ("auto", "scan", "pallas",
-                          "pallas_spmd"):
-            raise ValueError(f"unknown lstm_impl {self.lstm_impl!r}")
+        if self.lstm_impl not in ("auto", "scan", "pallas"):
+            raise ValueError(f"unknown lstm_impl {self.lstm_impl!r} "
+                             "(pallas_spmd was retired in r5 with the "
+                             "backward kernel — training always scans)")
         if self.stored_hidden_mode not in ("burn_in_start", "seq_start"):
             raise ValueError(
                 f"unknown stored_hidden_mode {self.stored_hidden_mode!r}")
@@ -239,11 +243,9 @@ class Config:
                 raise ValueError(
                     "obs_space_to_depth is for the nature/mlp torsos; the "
                     "impala torso consumes raw frames")
-        if self.lstm_impl in ("pallas", "pallas_spmd") and self.remat:
-            raise ValueError(
-                f"lstm_impl={self.lstm_impl!r} cannot honour remat=True "
-                "(the fused kernel always materialises its residuals); use "
-                "lstm_impl='auto' or 'scan' for rematerialised long unrolls")
+        # lstm_impl × remat needs no guard since r5: remat applies to the
+        # training scan, and training always scans — the pallas kernel
+        # only ever serves no-grad unrolls, where remat is meaningless
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)

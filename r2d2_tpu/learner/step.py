@@ -120,9 +120,12 @@ def _double_unroll(cfg: Config, net: R2D2Network, params, target_params,
     the recurrence walks T sequential steps once instead of twice, at
     double per-step batch — on the round-4 v5e measurement a B=128 unroll
     costs only 1.30x a B=64 one, so the fusion trades a free batch
-    doubling for half the latency-bound scan chain.  The fused path
-    pins the scan recurrence (a vmapped pallas_call would need its own
-    batching rule); scan measured at parity with the kernel on-chip."""
+    doubling for half the latency-bound scan chain.
+
+    ``net`` must be a scan-recurrence network — callers go through
+    :func:`_loss_net`, which enforces it (the Pallas kernel is
+    inference-only since r5 and would fail under the surrounding
+    grad / vmap)."""
     if not cfg.fused_double_unroll:
         q_online, _ = net.apply(params, batch["obs"], batch["last_action"],
                                 batch["last_reward"], batch["hidden"],
@@ -133,20 +136,29 @@ def _double_unroll(cfg: Config, net: R2D2Network, params, target_params,
                                     method=R2D2Network.unroll)
         return q_online, jax.lax.stop_gradient(q_target_seq)
 
-    from r2d2_tpu.models.network import create_network
-
-    loss_net = (create_network(cfg.replace(lstm_impl="scan"),
-                               net.action_dim)
-                if net.cfg.lstm_impl != "scan" or net.spmd_mesh is not None
-                else net)
     stacked = jax.tree.map(
         lambda p, t: jnp.stack([p, t]),
         params, jax.lax.stop_gradient(target_params))
     q_both, _ = jax.vmap(
-        lambda p: loss_net.apply(p, batch["obs"], batch["last_action"],
-                                 batch["last_reward"], batch["hidden"],
-                                 method=R2D2Network.unroll))(stacked)
+        lambda p: net.apply(p, batch["obs"], batch["last_action"],
+                            batch["last_reward"], batch["hidden"],
+                            method=R2D2Network.unroll))(stacked)
     return q_both[0], jax.lax.stop_gradient(q_both[1])
+
+
+def _loss_net(cfg: Config, net: R2D2Network) -> R2D2Network:
+    """The network the LOSS must unroll: the scan recurrence, always.
+
+    Built once per step-factory call (NOT per trace — the r4 advisor
+    flagged the shadow-network-inside-the-loss trap).  The Pallas
+    inference kernel resolves for acting/eval nets on TPU but has no
+    backward (ops/lstm.py, retired r5); all impls share one param
+    pytree, so swapping the engine is free."""
+    from r2d2_tpu.models.network import create_network, resolve_lstm_impl
+
+    if resolve_lstm_impl(cfg) == "scan":
+        return net
+    return create_network(cfg.replace(lstm_impl="scan"), net.action_dim)
 
 
 def loss_and_priorities(cfg: Config, net: R2D2Network, params, target_params,
@@ -187,6 +199,7 @@ def make_train_step(cfg: Config, net: R2D2Network):
     """Returns ``train_step(state, batch) -> (state, loss, priorities)``,
     ready to be wrapped in jax.jit (single-device) or pjit (mesh)."""
     opt = make_optimizer(cfg)
+    net = _loss_net(cfg, net)  # grad paths always run the scan recurrence
 
     def train_step(state: TrainState, batch: Dict[str, jnp.ndarray]):
         grad_fn = jax.value_and_grad(

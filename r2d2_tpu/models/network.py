@@ -124,16 +124,16 @@ class LSTMLayer(nn.Module):
 
     Two recurrence implementations behind the same parameters:
     - ``impl="scan"``: ``jax.lax.scan`` — portable, works on CPU and under
-      GSPMD meshes.
-    - ``impl="pallas"``: the fused Pallas kernel (ops/lstm.py) — the whole
-      unroll is one TPU program with the recurrent weights and h/c held in
-      VMEM across steps, removing the per-step kernel overhead and HBM
-      re-reads of the scan.  Measured on a real v5e
-      (tools/measure_tpu.py:pallas_lstm_section, round 4, B=64 T=85
-      H=512 bf16): fwd 1.07x faster than scan, fwd+bwd 0.96x (parity) —
-      XLA's scan lowering on current runtimes is much stronger than when
-      the r2 kernel first measured ~4x, so the kernel's remaining edge is
-      the inference path and its VMEM residency under shard_map.
+      GSPMD meshes, differentiable.  The ONLY training recurrence.
+    - ``impl="pallas"``: the fused inference kernel (ops/lstm.py) — the
+      whole unroll is one TPU program with the recurrent weights and h/c
+      held in VMEM across steps.  No-grad paths only (acting/eval):
+      the backward kernel was retired in r5 after the round-4 v5e
+      measurement (B=64 T=85 H=512 bf16) put fused fwd+bwd at 0.96x
+      scan; the inference edge (1.07x, residual-free) is what remains.
+      Differentiating this branch raises at trace time — the learner
+      builds its loss networks with ``lstm_impl="scan"``
+      (learner/step.py:make_train_step).
     """
     hidden_dim: int
     compute_dtype: Any = jnp.float32
@@ -141,13 +141,6 @@ class LSTMLayer(nn.Module):
     remat: bool = False
     impl: str = "scan"
     interpret: bool = False
-    # when set (a jax.sharding.Mesh with a "dp" axis), the pallas unroll
-    # runs inside shard_map over dp: each device executes the fused kernel
-    # on its batch shard with replicated weights — keeping the kernel's
-    # VMEM-residency win under data-parallel meshes, where a plain
-    # pallas_call cannot be GSPMD-partitioned.  The weight cotangent's
-    # cross-shard psum falls out of the shard_map transpose (in_spec P()).
-    spmd_mesh: Any = None
 
     @nn.compact
     def __call__(self, xs, h0, c0):
@@ -200,20 +193,7 @@ class LSTMLayer(nn.Module):
         # scan-impl network instead (actor.make_act_fn builds that twin;
         # the two impls declare identical parameters).
         if self.impl == "pallas":
-            if self.spmd_mesh is not None:
-                from jax.sharding import PartitionSpec as P
-
-                # check_vma=False: pallas_call's out_shapes carry no vma
-                # annotation; correctness (incl. the wh-cotangent psum) is
-                # pinned against the scan path in tests/test_parallel.py::
-                # test_pallas_spmd_sharded_step_matches_scan
-                hs, h, c = jax.shard_map(
-                    run_pallas, mesh=self.spmd_mesh,
-                    in_specs=(P("dp"), P(), P("dp"), P("dp")),
-                    out_specs=(P("dp"), P("dp"), P("dp")),
-                    check_vma=False)(x_proj, wh, h0f, c0f)
-            else:
-                hs, h, c = run_pallas(x_proj, wh, h0f, c0f)
+            hs, h, c = run_pallas(x_proj, wh, h0f, c0f)
         else:
             hs, h, c = run_scan(x_proj, wh, h0f, c0f)
         return hs, (h, c)
@@ -246,9 +226,6 @@ class R2D2Network(nn.Module):
     """
     action_dim: int
     cfg: Config
-    # Mesh for the pallas_spmd recurrence (see LSTMLayer.spmd_mesh); set
-    # by parallel.mesh._mesh_net, None everywhere else
-    spmd_mesh: Any = None
 
     def setup(self):
         cfg = self.cfg
@@ -261,15 +238,10 @@ class R2D2Network(nn.Module):
             torso_kw["s2d_input"] = cfg.obs_space_to_depth
         self.torso = torso_cls(**torso_kw)
         impl = resolve_lstm_impl(cfg)
-        spmd = None
-        if impl == "pallas_spmd":
-            # without a mesh (single-device jits, actor twins) the fused
-            # kernel runs plain — pallas_spmd only changes mesh behavior
-            impl, spmd = "pallas", self.spmd_mesh
         self.lstm_layers_ = [
             LSTMLayer(hidden_dim=cfg.hidden_dim, compute_dtype=cd,
                       param_dtype=pd, remat=cfg.remat, impl=impl,
-                      interpret=cfg.pallas_interpret, spmd_mesh=spmd,
+                      interpret=cfg.pallas_interpret,
                       name=f"lstm_{i}")
             for i in range(cfg.lstm_layers)
         ]
@@ -312,30 +284,23 @@ class R2D2Network(nn.Module):
 
 
 def resolve_lstm_impl(cfg: Config) -> str:
-    """``auto`` → the fused Pallas kernel on TPU, ``scan`` elsewhere.
-
-    ``auto`` also keeps the scan when ``cfg.remat`` is set: remat trades
-    FLOPs for memory by not materialising the scan carries, while the
-    Pallas kernel always streams its full residuals (hs/cs/gates) to HBM —
-    for long-unroll configs that need remat to fit, the scan is the right
-    engine.  ``pallas_spmd`` is explicit-only (never chosen by ``auto``):
-    under a dp mesh the fused kernel runs per-device inside shard_map
-    (parallel.mesh._mesh_net); everywhere else it behaves like ``pallas``.
+    """``auto`` → the fused Pallas inference kernel on TPU, ``scan``
+    elsewhere.  The resolved impl governs NO-GRAD unrolls only — any grad
+    path must use a ``lstm_impl="scan"`` network (the learner builds its
+    loss networks that way, learner/step.py:make_train_step; the Pallas
+    kernel has no backward since r5 and raises under differentiation).
 
     All implementations declare identical parameters, so checkpoints and
-    param pytrees are interchangeable between them (e.g. train with pallas
+    param pytrees are interchangeable between them (e.g. act with pallas
     on TPU, evaluate with scan on CPU).
     """
     if cfg.lstm_impl != "auto":
         return cfg.lstm_impl
-    if cfg.remat:
-        return "scan"
     return "pallas" if jax.default_backend() == "tpu" else "scan"
 
 
-def create_network(cfg: Config, action_dim: int,
-                   spmd_mesh: Any = None) -> R2D2Network:
-    return R2D2Network(action_dim=action_dim, cfg=cfg, spmd_mesh=spmd_mesh)
+def create_network(cfg: Config, action_dim: int) -> R2D2Network:
+    return R2D2Network(action_dim=action_dim, cfg=cfg)
 
 
 def init_params(cfg: Config, net: R2D2Network, key: jax.Array):

@@ -1,4 +1,4 @@
 """TPU Pallas kernels for the hot ops."""
-from r2d2_tpu.ops.lstm import lstm_unroll_pallas, make_lstm_unroll
+from r2d2_tpu.ops.lstm import lstm_unroll_pallas, make_lstm_infer
 
-__all__ = ["lstm_unroll_pallas", "make_lstm_unroll"]
+__all__ = ["lstm_unroll_pallas", "make_lstm_infer"]

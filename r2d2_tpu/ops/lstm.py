@@ -1,34 +1,30 @@
-"""Pallas fused LSTM unroll for TPU.
+"""Pallas fused LSTM **inference** unroll for TPU.
 
-Why: the hot op of the R2D2 train step is the LSTM recurrence (the analogue
-of the reference's cuDNN LSTM calls, model.py:51,95-100).  Under
-``jax.lax.scan`` each of the T=85 steps is its own XLA loop iteration that
-re-reads the (H, 4H) recurrent kernel from HBM and pays per-step kernel
-overhead — measured ~20 µs/step on v5e where the recurrent matmul itself is
-<1 µs of MXU time.  This kernel runs the **whole unroll as one Pallas
-program**: a sequential grid over T with the recurrent weights, h, and c
-held in VMEM across steps, so HBM traffic per step is just the (B, 4H)
-input-projection slice in and the (B, H) hidden slice out.
+Why: under ``jax.lax.scan`` each of the T unroll steps is its own XLA loop
+iteration that re-reads the (H, 4H) recurrent kernel from HBM and pays
+per-step kernel overhead.  This kernel runs the whole unroll as one Pallas
+program: a sequential grid over T with the recurrent weights, h, and c held
+in VMEM across steps, so HBM traffic per step is just the (B, 4H)
+input-projection slice in and the (B, H) hidden slice out.  It is the
+TPU-native stand-in for the implicit cuDNN fused LSTM the reference gets
+for free on the acting path (reference model.py:51,65-79).
 
-Design:
-- Forward: grid (T,).  Scratch ``h``/``c`` (float32) persist across the
-  sequential TPU grid.  Per step: ``gates = xp[t] + h @ wh`` (MXU,
-  float32 accumulation), gate nonlinearities on the VPU, then h/c update.
-  Activated gates and cell states are streamed out as residuals for the
-  backward pass.
-- Backward: custom VJP, grid (T,) iterated in reverse via the BlockSpec
-  index maps.  Carries ``dh``/``dc`` in scratch, accumulates ``dwh`` in a
-  float32 VMEM scratch written out once at the final grid step, and emits
-  the per-step ``dxp`` cotangent.  Gradients for the input projection
-  (``wi``, ``b``, ``xs``) fall out of XLA's autodiff of the (hoisted)
-  projection matmul outside this kernel.
-- Matmul operands are cast to ``compute_dtype`` (bfloat16 in the flagship
-  config) with float32 accumulation — one rounding *less* than the scan
-  path's bf16-output matmul, so results match the scan reference to bf16
-  tolerance (exactly, in float32 mode).  See tests/test_lstm_pallas.py.
+**Inference-only — the backward kernel was retired in round 5.**  The
+round-4 on-chip measurement (tools/measure_tpu.py:pallas_lstm_section,
+v5e, B=64 T=85 H=512 bf16) put the fused forward+backward at 0.96x the
+scan recurrence: XLA's scan lowering on current runtimes already keeps
+the MXU busy through the training path, so a 150-line custom-VJP kernel
+bought nothing there.  The forward-only (inference) path kept a 1.07x
+edge — actors and evaluators stream no residuals, and the kernel's
+VMEM-resident h/c is exactly what a T=1..85 acting unroll wants — so that
+half stays.  Training always runs the scan (learner/step.py builds its
+loss networks with ``lstm_impl="scan"``); differentiating through this
+kernel is unsupported and raises at trace time.
 
-The reference has no analogue: this is the TPU-native replacement for the
-implicit cuDNN fused LSTM the torch code gets for free.
+Numerics: matmul operands are cast to ``compute_dtype`` (bfloat16 in the
+flagship config) with float32 accumulation — one rounding *less* than the
+scan path's bf16-output matmul, so results match the scan reference to
+bf16 tolerance (exactly, in float32 mode).  See tests/test_lstm_pallas.py.
 """
 from __future__ import annotations
 
@@ -47,39 +43,11 @@ def _sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
-def _fwd_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
-                hs_ref, cs_ref, gates_ref, h_scr, c_scr, *, compute_dtype):
-    t = pl.program_id(0)
-
-    @pl.when(t == 0)
-    def _():
-        h_scr[:] = h0_ref[:].astype(jnp.float32)
-        c_scr[:] = c0_ref[:].astype(jnp.float32)
-
-    h = h_scr[:]
-    c = c_scr[:]
-    H = h.shape[-1]
-    gates = xp_ref[0] + jnp.dot(h.astype(compute_dtype), wh_ref[:],
-                                preferred_element_type=jnp.float32)
-    si = _sigmoid(gates[:, 0 * H:1 * H])
-    sf = _sigmoid(gates[:, 1 * H:2 * H])
-    tg = jnp.tanh(gates[:, 2 * H:3 * H])
-    so = _sigmoid(gates[:, 3 * H:4 * H])
-    c_new = sf * c + si * tg
-    h_new = so * jnp.tanh(c_new)
-
-    gates_ref[0] = jnp.concatenate([si, sf, tg, so], axis=-1)
-    hs_ref[0] = h_new
-    cs_ref[0] = c_new
-    h_scr[:] = h_new
-    c_scr[:] = c_new
-
-
 def _fwd_infer_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
                       hs_ref, cT_ref, h_scr, c_scr, *, compute_dtype):
-    """Residual-free forward for the primal (inference) path: same math as
-    :func:`_fwd_kernel` but without streaming gates/cell states to HBM —
-    actors and evaluators only need hs and the final (h, c)."""
+    """Residual-free forward: per step ``gates = xp[t] + h @ wh`` (MXU,
+    float32 accumulation), gate nonlinearities on the VPU (order i,f,g,o),
+    h/c carried in VMEM scratch across the sequential grid."""
     t = pl.program_id(0)
     T = pl.num_programs(0)
 
@@ -109,66 +77,9 @@ def _fwd_infer_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
         cT_ref[:] = c_new
 
 
-def _bwd_kernel(dhs_ref, dcT_ref, wh_ref, gates_ref, cs_ref, hprev_ref,
-                cprev_ref, dxp_ref, dwh_ref, dh0_ref, dc0_ref,
-                dh_scr, dc_scr, dwh_scr, *, compute_dtype):
-    pid = pl.program_id(0)
-    T = pl.num_programs(0)
-
-    @pl.when(pid == 0)
-    def _():
-        dh_scr[:] = jnp.zeros_like(dh_scr)
-        dc_scr[:] = dcT_ref[:]
-        dwh_scr[:] = jnp.zeros_like(dwh_scr)
-
-    H = dh_scr.shape[-1]
-    # cotangent for h_s: carried dh plus this step's output cotangent
-    dh = dh_scr[:] + dhs_ref[0]
-    g = gates_ref[0]
-    si = g[:, 0 * H:1 * H]
-    sf = g[:, 1 * H:2 * H]
-    tg = g[:, 2 * H:3 * H]
-    so = g[:, 3 * H:4 * H]
-    tc = jnp.tanh(cs_ref[0])
-
-    do_ = dh * tc
-    dc = dc_scr[:] + dh * so * (1.0 - tc * tc)
-    di = dc * tg
-    dg = dc * si
-    df = dc * cprev_ref[0]
-    dc_prev = dc * sf
-
-    dzi = di * si * (1.0 - si)
-    dzf = df * sf * (1.0 - sf)
-    dzg = dg * (1.0 - tg * tg)
-    dzo = do_ * so * (1.0 - so)
-    dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)  # (B, 4H) f32
-
-    dxp_ref[0] = dz
-    dz_cd = dz.astype(compute_dtype)
-    # dh_prev = dz @ wh^T : contract the 4H dim
-    dh_prev = jax.lax.dot_general(
-        dz_cd, wh_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    # dwh += h_prev^T @ dz : contract the batch dim
-    dwh_scr[:] += jax.lax.dot_general(
-        hprev_ref[0].astype(compute_dtype), dz_cd,
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-
-    dh_scr[:] = dh_prev
-    dc_scr[:] = dc_prev
-
-    @pl.when(pid == T - 1)
-    def _():
-        dwh_ref[:] = dwh_scr[:]
-        dh0_ref[:] = dh_prev
-        dc0_ref[:] = dc_prev
-
-
 @functools.lru_cache(maxsize=None)
-def make_lstm_unroll(compute_dtype: Any, interpret: bool):
-    """Build the custom-VJP fused unroll for one (dtype, interpret) combo.
+def make_lstm_infer(compute_dtype: Any, interpret: bool):
+    """Build the fused inference unroll for one (dtype, interpret) combo.
 
     Returned fn: ``(xp, wh, h0, c0) -> (hs, h_T, c_T)`` with
     - ``xp``: (T, B, 4H) float32 — hoisted input projection (x@wi + b),
@@ -176,45 +87,13 @@ def make_lstm_unroll(compute_dtype: Any, interpret: bool):
     - ``h0``/``c0``: (B, H) float32,
     - ``hs``: (T, B, H) float32 hidden states, ``h_T``/``c_T`` finals.
 
-    Differentiable in xp, wh, h0, c0.
+    NOT differentiable (the backward kernel was retired; see module
+    docstring) — use the scan recurrence for any grad path.
     """
     cd = compute_dtype
 
     def _scratch(shape):
         return pltpu.VMEM(shape, jnp.float32)
-
-    def _fwd_call(xp, wh, h0, c0):
-        T, B, H4 = xp.shape
-        H = H4 // 4
-        f32 = jnp.float32
-        kernel = functools.partial(_fwd_kernel, compute_dtype=cd)
-        mem = {} if interpret else dict(memory_space=_VMEM)
-        hs, cs, gates = pl.pallas_call(
-            kernel,
-            grid=(T,),
-            in_specs=[
-                pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0), **mem),
-                pl.BlockSpec((H, H4), lambda t: (0, 0), **mem),
-                pl.BlockSpec((B, H), lambda t: (0, 0), **mem),
-                pl.BlockSpec((B, H), lambda t: (0, 0), **mem),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, B, H), lambda t: (t, 0, 0), **mem),
-                pl.BlockSpec((1, B, H), lambda t: (t, 0, 0), **mem),
-                pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0), **mem),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((T, B, H), f32),
-                jax.ShapeDtypeStruct((T, B, H), f32),
-                jax.ShapeDtypeStruct((T, B, H4), f32),
-            ],
-            scratch_shapes=[
-                _scratch((B, H)),
-                _scratch((B, H)),
-            ],
-            interpret=interpret,
-        )(xp, wh, h0.astype(f32), c0.astype(f32))
-        return hs, cs, gates
 
     def _infer_call(xp, wh, h0, c0):
         T, B, H4 = xp.shape
@@ -245,79 +124,15 @@ def make_lstm_unroll(compute_dtype: Any, interpret: bool):
             ],
             interpret=interpret,
         )(xp, wh, h0.astype(f32), c0.astype(f32))
-        return hs, cT
-
-    def _bwd_call(wh, hs, cs, gates, h0, c0, dhs, dcT):
-        T, B, H = hs.shape
-        H4 = 4 * H
-        f32 = jnp.float32
-        hprev = jnp.concatenate([h0.astype(f32)[None], hs[:-1]], axis=0)
-        cprev = jnp.concatenate([c0.astype(f32)[None], cs[:-1]], axis=0)
-        kernel = functools.partial(_bwd_kernel, compute_dtype=cd)
-        mem = {} if interpret else dict(memory_space=_VMEM)
-        rev = lambda t: (T - 1 - t, 0, 0)  # noqa: E731 — reversed time
-        fix = lambda t: (0, 0)             # noqa: E731
-        dxp, dwh, dh0, dc0 = pl.pallas_call(
-            kernel,
-            grid=(T,),
-            in_specs=[
-                pl.BlockSpec((1, B, H), rev, **mem),    # dhs
-                pl.BlockSpec((B, H), fix, **mem),       # dcT
-                pl.BlockSpec((H, H4), fix, **mem),      # wh
-                pl.BlockSpec((1, B, H4), rev, **mem),   # gates
-                pl.BlockSpec((1, B, H), rev, **mem),    # cs
-                pl.BlockSpec((1, B, H), rev, **mem),    # hprev
-                pl.BlockSpec((1, B, H), rev, **mem),    # cprev
-            ],
-            out_specs=[
-                pl.BlockSpec((1, B, H4), rev, **mem),   # dxp
-                pl.BlockSpec((H, H4), fix, **mem),      # dwh
-                pl.BlockSpec((B, H), fix, **mem),       # dh0
-                pl.BlockSpec((B, H), fix, **mem),       # dc0
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((T, B, H4), f32),
-                jax.ShapeDtypeStruct((H, H4), f32),
-                jax.ShapeDtypeStruct((B, H), f32),
-                jax.ShapeDtypeStruct((B, H), f32),
-            ],
-            scratch_shapes=[
-                _scratch((B, H)),
-                _scratch((B, H)),
-                _scratch((H, H4)),
-            ],
-            interpret=interpret,
-        )(dhs, dcT, wh, gates, cs, hprev, cprev)
-        return dxp, dwh, dh0, dc0
-
-    @jax.custom_vjp
-    def lstm_unroll(xp, wh, h0, c0):
-        # primal (inference) path: no backward will run, so skip the
-        # gates/cs residual streams — ~6x less HBM write traffic for the
-        # actor/eval unrolls.  fwd() below is what grad tracing uses.
-        hs, cT = _infer_call(xp, wh, h0, c0)
         return hs, hs[-1], cT
 
-    def fwd(xp, wh, h0, c0):
-        hs, cs, gates = _fwd_call(xp, wh, h0, c0)
-        return (hs, hs[-1], cs[-1]), (wh, hs, cs, gates, h0, c0)
-
-    def bwd(res, cot):
-        wh, hs, cs, gates, h0, c0 = res
-        dhs, dhT, dcT = cot
-        # the final-h cotangent is just an extra contribution to hs[-1]
-        dhs = dhs.at[-1].add(dhT)
-        dxp, dwh, dh0, dc0 = _bwd_call(wh, hs, cs, gates, h0, c0, dhs, dcT)
-        return dxp, dwh.astype(wh.dtype), dh0, dc0
-
-    lstm_unroll.defvjp(fwd, bwd)
-    return lstm_unroll
+    return _infer_call
 
 
 def lstm_unroll_pallas(xp_tm: jnp.ndarray, wh: jnp.ndarray, h0: jnp.ndarray,
                        c0: jnp.ndarray, *, compute_dtype: Any = jnp.bfloat16,
                        interpret: bool = False
                        ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Fused LSTM unroll: see :func:`make_lstm_unroll` for shapes."""
-    fn = make_lstm_unroll(compute_dtype, interpret)
+    """Fused inference unroll: see :func:`make_lstm_infer` for shapes."""
+    fn = make_lstm_infer(compute_dtype, interpret)
     return fn(xp_tm, wh.astype(compute_dtype), h0, c0)

@@ -165,7 +165,7 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
     be derived; a dp-only mesh replicates the whole state.
     """
     st_shard = _validate_mesh_step(cfg, mesh, state_template)
-    step = make_train_step(cfg, _mesh_net(cfg, net, mesh))
+    step = make_train_step(cfg, net)  # _loss_net routes scan
     repl = replicated(mesh)
     dp = NamedSharding(mesh, P("dp"))
     return jax.jit(
@@ -174,41 +174,6 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
         out_shardings=(st_shard, repl, dp),
         donate_argnums=(0,),
     )
-
-
-def _mesh_net(cfg: Config, net: R2D2Network, mesh: Mesh) -> R2D2Network:
-    """The network variant a mesh-compiled step must use.
-
-    The fused Pallas LSTM is a single-device program GSPMD cannot
-    partition, so under a mesh:
-
-    - ``lstm_impl="pallas_spmd"`` (explicit opt-in) keeps the fused
-      kernel by running it per-device inside ``shard_map`` over ``dp``
-      (models/network.py:LSTMLayer.spmd_mesh) — dp-only meshes, since an
-      mp-sharded recurrent kernel would split the 4H gate dim the kernel
-      needs whole.
-    - ``"auto"`` falls back to the scan recurrence — identical params.
-    - an explicit ``"pallas"`` request is an error.
-    """
-    from r2d2_tpu.models.network import create_network, resolve_lstm_impl
-
-    resolved = resolve_lstm_impl(cfg)
-    if resolved == "pallas_spmd":
-        if "mp" in mesh.axis_names and mesh.shape["mp"] > 1:
-            raise ValueError(
-                "lstm_impl='pallas_spmd' supports dp-only meshes: an "
-                "mp-sharded recurrent kernel would split the 4H gate dim "
-                "the fused kernel needs whole; use lstm_impl='auto'/'scan' "
-                "for mp meshes")
-        return create_network(cfg, net.action_dim, spmd_mesh=mesh)
-    if resolved != "pallas":
-        return net
-    if cfg.lstm_impl == "pallas":
-        raise ValueError(
-            "lstm_impl='pallas' cannot run under a mesh (GSPMD cannot "
-            "partition the fused kernel); use lstm_impl='auto', 'scan', "
-            "or 'pallas_spmd'")
-    return create_network(cfg.replace(lstm_impl="scan"), net.action_dim)
 
 
 def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
@@ -270,7 +235,7 @@ def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
                 in_specs=(P("dp"), P("dp"), P("dp")),
                 out_specs=P("dp"))(arrays, ints_t, w_t)
 
-    fn = make_super_step_fn(cfg, _mesh_net(cfg, net, mesh), k,
+    fn = make_super_step_fn(cfg, net, k,
                             gather=gather)
     repl = replicated(mesh)
     dp_b = NamedSharding(mesh, P(None, "dp"))
@@ -326,7 +291,7 @@ def sharded_in_graph_per_super_step(cfg: Config, net: R2D2Network,
                     jax.lax.with_sharding_constraint(w_t, dp_rows))
 
         fn = make_in_graph_per_super_step_fn(
-            cfg, _mesh_net(cfg, net, mesh), k, constrain=constrain)
+            cfg, net, k, constrain=constrain)
         return jax.jit(
             fn,
             in_shardings=(st_shard, ring_sharding(mesh, "replicated"),
@@ -352,7 +317,7 @@ def sharded_in_graph_per_super_step(cfg: Config, net: R2D2Network,
     B = cfg.batch_size
     Bg = B // dp
     beta = cfg.importance_sampling_exponent
-    step = make_train_step(cfg, _mesh_net(cfg, net, mesh))
+    step = make_train_step(cfg, net)  # _loss_net routes scan
     per_sh = per_sharding(mesh, "dp")
     dp_rows = NamedSharding(mesh, P("dp"))
 

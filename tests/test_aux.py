@@ -108,11 +108,16 @@ def test_supervisor_join_all_cancels_pending_restart():
     assert not sup.threads["late"].alive
 
 
-def test_config_rejects_pallas_with_remat():
+def test_config_pallas_composes_with_remat_and_rejects_spmd():
+    """Since r5 the pallas impl is inference-only, so remat (a training
+    -scan concern) composes freely; the retired pallas_spmd impl must
+    fail with the retirement message, not pass silently."""
     from r2d2_tpu.config import test_config
 
-    with pytest.raises(ValueError, match="remat"):
-        test_config(lstm_impl="pallas", remat=True)
+    cfg = test_config(lstm_impl="pallas", remat=True)  # no longer an error
+    assert cfg.remat and cfg.lstm_impl == "pallas"
+    with pytest.raises(ValueError, match="retired"):
+        test_config(lstm_impl="pallas_spmd")
 
 
 def test_supervisor_healthy_thread_runs_clean():

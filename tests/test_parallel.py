@@ -192,47 +192,3 @@ def test_mp_mesh_requires_state_template():
         sharded_train_step(cfg, net, make_mesh(cfg))
 
 
-@pytest.mark.slow
-def test_pallas_spmd_sharded_step_matches_scan():
-    """lstm_impl='pallas_spmd': the fused kernel runs per-device inside
-    shard_map over dp (interpret mode on this CPU mesh) and must reproduce
-    the scan-recurrence sharded step — same loss, priorities, params (the
-    two impls declare identical parameters)."""
-    cfg_scan = make_test_config(lstm_impl="scan")
-    cfg_spmd = make_test_config(lstm_impl="pallas_spmd",
-                                pallas_interpret=True)
-    net_scan = create_network(cfg_scan, A)
-    net_spmd = create_network(cfg_spmd, A)
-    params = init_params(cfg_scan, net_scan, jax.random.PRNGKey(3))
-    batch = make_batch(cfg_scan, np.random.default_rng(3))
-
-    mesh = make_mesh(cfg_scan)
-    step_a = sharded_train_step(cfg_scan, net_scan, mesh)
-    sa, loss_a, prio_a = step_a(
-        replicate_state(mesh, create_train_state(cfg_scan, params)),
-        shard_batch(mesh, batch))
-
-    step_b = sharded_train_step(cfg_spmd, net_spmd, mesh)
-    sb, loss_b, prio_b = step_b(
-        replicate_state(mesh, create_train_state(cfg_spmd, params)),
-        shard_batch(mesh, batch))
-
-    assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-5)
-    np.testing.assert_allclose(np.asarray(prio_a), np.asarray(prio_b),
-                               rtol=1e-4, atol=1e-6)
-    for pa, pb in zip(jax.tree.leaves(sa.params), jax.tree.leaves(sb.params)):
-        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
-                                   rtol=1e-4, atol=1e-6)
-
-
-def test_pallas_spmd_rejects_mp_mesh():
-    """An mp-sharded recurrent kernel would split the 4H gate dim the
-    fused kernel needs whole — explicit error, not silent wrong numbers."""
-    cfg = make_test_config(lstm_impl="pallas_spmd", pallas_interpret=True,
-                           mesh_shape=(("dp", 4), ("mp", 2)))
-    net = create_network(cfg, A)
-    params = init_params(cfg, net, jax.random.PRNGKey(0))
-    mesh = make_mesh(cfg)
-    state = create_train_state(cfg, params)
-    with pytest.raises(ValueError, match="dp-only"):
-        sharded_train_step(cfg, net, mesh, state_template=state)

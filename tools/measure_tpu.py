@@ -53,11 +53,12 @@ from r2d2_tpu.utils.batch import synthetic_batch
 
 
 def pallas_lstm_section(quick: bool) -> None:
-    """On-chip validation of the fused Pallas LSTM (ops/lstm.py) against
-    the scan recurrence behind the same parameters, at flagship shapes
-    (B=64, T=85, H=512, bf16 compute — the cuDNN-LSTM analogue,
-    reference model.py:51).  ``quick`` shrinks shapes and interprets the
-    kernel so the section itself smokes on CPU."""
+    """On-chip validation of the fused Pallas inference LSTM (ops/lstm.py)
+    against the scan recurrence behind the same parameters, at flagship
+    shapes (B=64, T=85, H=512, bf16 compute — the no-grad acting/eval
+    path; the backward kernel was retired in r5 after measuring 0.96x
+    scan on this very section).  ``quick`` shrinks shapes and interprets
+    the kernel so the section itself smokes on CPU."""
     from r2d2_tpu.models.network import LSTMLayer
 
     B, T, H, F = (64, 85, 512, 512) if not quick else (4, 6, 16, 16)
@@ -74,32 +75,15 @@ def pallas_lstm_section(quick: bool) -> None:
     def run(layer):
         return jax.jit(lambda p, x, h, c: layer.apply(p, x, h, c))
 
-    def grads(layer):
-        def loss(p, x, h, c):
-            hs, (hT, cT) = layer.apply(p, x, h, c)
-            return (hs * hs).mean() + (hT * cT).mean()
-
-        return jax.jit(jax.grad(loss, argnums=(0, 2, 3)))
-
-    # one jitted executable per (layer, fwd/grad) — the equality checks
-    # and timing loops below share them, so each flagship graph compiles
-    # exactly once on the chip
     f_scan, f_pal = run(scan_l), run(pal_l)
-    g_scan, g_pal = grads(scan_l), grads(pal_l)
 
-    # equality: fwd (inference path), then grads (training path — runs the
-    # residual-streaming fwd + reverse-grid bwd kernels)
     hs_s, (hT_s, cT_s) = f_scan(params, xs, h0, c0)
     hs_p, (hT_p, cT_p) = f_pal(params, xs, h0, c0)
     for a, b_, nm in ((hs_s, hs_p, "hs"), (hT_s, hT_p, "hT"),
                       (cT_s, cT_p, "cT")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-2, atol=2e-2, err_msg=nm)
-    g_s = g_scan(params, xs, h0, c0)
-    g_p = g_pal(params, xs, h0, c0)
-    jax.tree.map(lambda a, b_: np.testing.assert_allclose(
-        np.asarray(a), np.asarray(b_), rtol=5e-2, atol=5e-3), g_s, g_p)
-    print("pallas LSTM: fwd/infer/bwd MATCH scan at bf16 tolerance "
+    print("pallas LSTM (inference): fwd MATCHES scan at bf16 tolerance "
           f"(B={B} T={T} H={H})", flush=True)
 
     # timing: the already-compiled executables, median of reps, fetch-fenced
@@ -113,34 +97,17 @@ def pallas_lstm_section(quick: bool) -> None:
         return float(np.median(times)) * 1000
 
     t_scan, t_pal = time_layer(f_scan), time_layer(f_pal)
-    print(f"pallas LSTM fwd timing: scan {t_scan:.2f} ms, pallas "
-          f"{t_pal:.2f} ms → {t_scan / t_pal:.2f}x", flush=True)
+    print(f"pallas LSTM infer timing: scan {t_scan:.2f} ms, pallas "
+          f"{t_pal:.2f} ms -> {t_scan / t_pal:.2f}x "
+          "(the kernel must beat 1.0 to keep earning its keep)",
+          flush=True)
 
-    def time_grads(g, reps=20):
-        times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            out = g(params, xs, h0, c0)
-            np.asarray(jax.tree.leaves(out)[1]).ravel()[0]
-            times.append(time.perf_counter() - t0)
-        return float(np.median(times)) * 1000
-
-    tg_scan, tg_pal = time_grads(g_scan), time_grads(g_pal)
-    print(f"pallas LSTM fwd+bwd timing: scan {tg_scan:.2f} ms, pallas "
-          f"{tg_pal:.2f} ms → {tg_scan / tg_pal:.2f}x", flush=True)
-
-    # pallas_spmd: the shard_map wrapping must lower and agree on a
-    # 1-device dp mesh (the only mesh this sandbox can offer the chip)
-    from jax.sharding import Mesh
-
-    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
-    spmd_l = LSTMLayer(H, compute_dtype=cd, impl="pallas",
-                       interpret=quick, spmd_mesh=mesh)
-    hs_m, (hT_m, cT_m) = run(spmd_l)(params, xs, h0, c0)
-    np.testing.assert_allclose(np.asarray(hs_m), np.asarray(hs_p),
+    # T=1 acting shape: the actor hot path is a grid=(1,) unroll
+    hs1_p, (h1_p, c1_p) = f_pal(params, xs[:, :1], h0, c0)
+    hs1_s, (h1_s, c1_s) = f_scan(params, xs[:, :1], h0, c0)
+    np.testing.assert_allclose(np.asarray(hs1_p), np.asarray(hs1_s),
                                rtol=2e-2, atol=2e-2)
-    print("pallas_spmd: shard_map-wrapped kernel lowered and matches "
-          "on a dp=1 mesh", flush=True)
+    print("pallas LSTM T=1 acting unroll matches scan", flush=True)
 
 
 def _fused_unroll_section(base_cfg, A: int) -> None:
@@ -247,10 +214,10 @@ def main(quick: bool = False) -> None:
           f"ratio {t128 / t64:.2f} (double-unroll fusion pays if << 2)",
           flush=True)
 
-    # --- 2b. Pallas fused LSTM, NON-interpret: equality vs scan at the
-    # flagship shapes (fwd/infer/bwd) + measured speedup + one
-    # pallas_spmd shard_map lowering on a 1-device dp mesh (VERDICT r3
-    # item 3 — these had only ever run interpreted on CPU).
+    # --- 2b. Pallas fused inference LSTM, NON-interpret: equality vs
+    # scan at the flagship shapes + measured speedup (the training/bwd
+    # kernel was retired in r5; this section now decides whether the
+    # inference kernel keeps earning its keep).
     try:
         pallas_lstm_section(quick)
     except Exception as e:
