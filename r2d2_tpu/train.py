@@ -169,6 +169,20 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                     f"fits={fits} (ring {need / dp_local / 1e9:.1f} GB "
                     "per device); using host staging instead",
                     stacklevel=2)
+    if cfg.in_graph_per and ring is None:
+        # a ring fallback above (doesn't fit / multi-host shapes failed)
+        # must degrade the PER plane with it: device PER cannot run on
+        # host staging (ReplayBuffer would fail fast), and the reference
+        # behavior here is host replay, not a crash.  The presets default
+        # in_graph_per=True, so a single small-HBM chip lands here.
+        import warnings
+
+        warnings.warn(
+            "in_graph_per disabled: no device ring was built (see the "
+            "fallback warning above) — continuing on host-sampled PER; "
+            "shrink buffer_capacity to restore the device-PER plane",
+            stacklevel=2)
+        cfg = cfg.replace(in_graph_per=False)
     buffer = ReplayBuffer(cfg, action_dim,
                           rng=np.random.default_rng(cfg.seed),
                           device_ring=ring)
@@ -230,7 +244,8 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     # result pipeline would defer priority feedback (this path applies it
     # after every single update)
     cfg = cfg.replace(prefetch_batches=0, env_workers=0, actor_fleets=1,
-                      device_replay=False, superstep_pipeline=0)
+                      device_replay=False, in_graph_per=False,
+                      superstep_pipeline=0)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     actor: VectorActor = sys["actor"]
     buffer: ReplayBuffer = sys["buffer"]

@@ -439,3 +439,42 @@ def test_in_graph_per_without_ring_fails_fast():
     with pytest.raises(ValueError, match="in_graph_per=False"):
         ReplayBuffer(cfg, A, rng=np.random.default_rng(0),
                      device_ring=None)
+
+
+def test_train_degrades_in_graph_per_without_ring(monkeypatch):
+    """The flagship presets default in_graph_per=True; on a host whose
+    device budget rejects the ring, train() must warn and continue on
+    host-sampled PER (the reference's behavior is host replay, never a
+    crash).  Forced here by making every ring look too big."""
+    import importlib
+    import warnings
+
+    train_mod = importlib.import_module("r2d2_tpu.train")
+
+    monkeypatch.setattr(train_mod, "_device_memory_bytes", lambda: 1)
+    cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=4,
+                   log_interval=0.2)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        metrics = train_mod.train(
+            cfg,
+            env_factory=lambda c, seed: FakeAtariEnv(
+                obs_shape=c.stored_obs_shape, action_dim=A, seed=seed),
+            verbose=False)
+    assert any("in_graph_per disabled" in str(x.message) for x in w)
+    assert metrics["num_updates"] >= cfg.training_steps
+    assert np.isfinite(metrics["mean_loss"])
+    assert not metrics["fabric_failed"]
+
+
+def test_train_sync_accepts_in_graph_preset():
+    """train_sync force-disables device_replay; it must drop in_graph_per
+    with it (the pair is validated together) so the deterministic
+    debug trainer accepts the flagship presets unchanged."""
+    from r2d2_tpu.train import train_sync
+
+    cfg = make_cfg(game_name="Fake", training_steps=3)
+    out = train_sync(cfg, env_factory=lambda c, seed: FakeAtariEnv(
+        obs_shape=c.stored_obs_shape, action_dim=A, seed=seed))
+    assert out["num_updates"] >= 3
+    assert np.isfinite(out["mean_loss"])
