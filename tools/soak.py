@@ -8,12 +8,16 @@ Checks at exit: zero fabric failures, exact priority accounting (buffer
 counter == learner counter), no throughput decay (last-third updates/s
 within 20% of the middle third), and prints the health/trace summary.
 
-Run:  python tools/soak.py [minutes] [--device] [--ingraph]
+Run:  python tools/soak.py [minutes] [--device] [--ingraph] [--dp]
           [--out OUT.json]
 
 ``--ingraph`` soaks the device-PER drivetrain (cfg.in_graph_per):
 priority feedback never crosses the host, and note_updates keeps the
 accounting check exact.
+
+``--dp`` soaks the dp-sharded ring composition on a virtual dp=4 x mp=2
+CPU mesh (8 forced host devices) — with ``--ingraph`` that is the
+pod-layout device-PER fabric (per-slab shard_map sampling).
 """
 import json
 import os
@@ -25,6 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _argv = sys.argv[1:]
 DEVICE = "--device" in _argv
 INGRAPH = "--ingraph" in _argv
+DP = "--dp" in _argv
+if DP and not DEVICE:
+    # the virtual mesh needs its device count set before backend init
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 OUT = None
 if "--out" in _argv:
     i = _argv.index("--out")
@@ -58,12 +69,14 @@ def main(minutes: float = 20.0) -> int:
         device_replay=True, superstep_k=4, superstep_pipeline=2,
         in_graph_per=INGRAPH,
         actor_fleets=2, env_workers=2,
-        training_steps=10**9, log_interval=10.0)
+        training_steps=10**9, log_interval=10.0,
+        **(dict(device_ring_layout="dp",
+                mesh_shape=(("dp", 4), ("mp", 2))) if DP else {}))
     t0 = time.time()
     m = train(cfg, env_factory=lambda c, s: FakeAtariEnv(
                   obs_shape=c.stored_obs_shape, action_dim=4, seed=s,
                   episode_len=200),
-              max_wall_seconds=minutes * 60.0, verbose=False)
+              use_mesh=DP, max_wall_seconds=minutes * 60.0, verbose=False)
     wall = time.time() - t0
 
     rates = [e["updates_per_sec"] for e in m["logs"]
