@@ -478,3 +478,23 @@ def test_train_sync_accepts_in_graph_preset():
         obs_shape=c.stored_obs_shape, action_dim=A, seed=seed))
     assert out["num_updates"] >= 3
     assert np.isfinite(out["mean_loss"])
+
+
+@pytest.mark.slow
+def test_train_end_to_end_in_graph_per_dp_fused():
+    """The full composition stack at once: dp-sharded ring + device PER
+    + fused double unroll on a dp=4 x mp=2 mesh — every r4/r5 throughput
+    feature live in one fabric."""
+    from r2d2_tpu.train import train
+
+    cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=8,
+                   device_ring_layout="dp", fused_double_unroll=True,
+                   log_interval=0.2, mesh_shape=(("dp", 4), ("mp", 2)))
+    metrics = train(
+        cfg,
+        env_factory=lambda c, seed: FakeAtariEnv(
+            obs_shape=c.stored_obs_shape, action_dim=A, seed=seed),
+        use_mesh=True, verbose=False)
+    assert metrics["num_updates"] >= cfg.training_steps
+    assert np.isfinite(metrics["mean_loss"])
+    assert not metrics["fabric_failed"]
