@@ -23,11 +23,16 @@ Modes (composable):
   Mutually exclusive with ``--nature``.
 
 Run:  python tools/make_curves.py [out.json] [--fabric]
-          [--nature|--impala] [--ingraph] [--seed N]
+          [--nature|--impala] [--ingraph] [--dp] [--seed N]
 
 ``--ingraph`` (requires --fabric) runs the device-PER drivetrain
 (cfg.in_graph_per) — learning evidence for the zero-host-round-trip
 sampling/feedback plane on the production families.
+
+``--dp`` (requires --fabric) shards the ring over a virtual dp=4 x mp=2
+CPU mesh — learning evidence for the per-slab fixed-quota sampling
+deviation of the pod layout (with --ingraph: the grouped in-graph
+sampler).
 """
 import json
 import os
@@ -35,6 +40,13 @@ import shutil
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--dp" in sys.argv[1:]:
+    # the virtual mesh needs its device count set before backend init
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
@@ -58,7 +70,7 @@ def env_factory(cfg, seed):
 
 def main(out_path: str = None, fabric: bool = False,
          torso: str = "mlp", seed: int = 0,
-         ingraph: bool = False) -> None:
+         ingraph: bool = False, dp: bool = False) -> None:
     if out_path is None:
         # mode-derived defaults so `--fabric`/`--nature`/`--seed` can
         # never silently overwrite another mode's evidence artifact
@@ -68,6 +80,8 @@ def main(out_path: str = None, fabric: bool = False,
             stem += "_FABRIC"
         if ingraph:
             stem += "_INGRAPH"
+        if dp:
+            stem += "_DP"
         suffix = f"_s{seed}" if seed else ""
         out_path = f"{stem}_r04{suffix}.json"
     # lr is deliberately NOT the reference's 1e-4: that value is tuned for
@@ -102,9 +116,12 @@ def main(out_path: str = None, fabric: bool = False,
         # (cadences fire on interval crossings, learner.py).
         cfg = cfg.replace(num_actors=4, actor_fleets=2, device_replay=True,
                           superstep_k=4, superstep_pipeline=2,
-                          in_graph_per=ingraph)
-    elif ingraph:
-        raise SystemExit("--ingraph requires --fabric (device replay)")
+                          in_graph_per=ingraph,
+                          **(dict(device_ring_layout="dp",
+                                  mesh_shape=(("dp", 4), ("mp", 2)))
+                             if dp else {}))
+    elif ingraph or dp:
+        raise SystemExit("--ingraph/--dp require --fabric (device replay)")
     ckpt_dir = os.path.join(os.path.dirname(out_path) or ".",
                             "_curves_ckpts")
     # stale checkpoints from a previous run (possibly a different arch or
@@ -116,7 +133,7 @@ def main(out_path: str = None, fabric: bool = False,
           f"({'threaded fabric' if fabric else 'train_sync'}), checkpoint "
           f"every {cfg.save_interval}", flush=True)
     if fabric:
-        metrics = train(cfg, env_factory=env_factory,
+        metrics = train(cfg, env_factory=env_factory, use_mesh=dp,
                         checkpoint_dir=ckpt_dir, verbose=False)
         assert not metrics["fabric_failed"], "fabric reported a failure"
     else:
@@ -189,7 +206,7 @@ if __name__ == "__main__":
     torso = ("nature" if "--nature" in argv
              else "impala" if "--impala" in argv else "mlp")
     usage = ("usage: make_curves.py [out.json] [--fabric] "
-             "[--nature|--impala] [--ingraph] [--seed N]")
+             "[--nature|--impala] [--ingraph] [--dp] [--seed N]")
     seed = 0
     if "--seed" in argv:
         i = argv.index("--seed")
@@ -199,8 +216,10 @@ if __name__ == "__main__":
             sys.exit(usage)
         argv = argv[:i] + argv[i + 2:]
     args = [a for a in argv
-            if a not in ("--fabric", "--nature", "--impala", "--ingraph")]
+            if a not in ("--fabric", "--nature", "--impala", "--ingraph",
+                         "--dp")]
     if any(a.startswith("--") for a in args):
         sys.exit(usage)  # e.g. a mistyped --seed=1 must not become out_path
     main(args[0] if args else None, fabric="--fabric" in argv,
+         dp="--dp" in argv,
          torso=torso, seed=seed, ingraph="--ingraph" in argv)
