@@ -77,6 +77,23 @@ class AgentState:
         self.hidden = np.asarray(hidden, np.float32)
 
 
+def fleet_shards(cfg: Config):
+    """``([(lo, hi), ...], env_workers_per_fleet)`` — the single
+    definition of the fleet split, shared by the thread transport
+    (train._build) and the process transport (parallel/actor_procs) so
+    lane→fleet assignment and the global ladder-epsilon slices can never
+    diverge between transports.  Lanes split contiguously over
+    ``cfg.actor_fleets``; the env-worker budget is a per-HOST tuning
+    knob, split across the fleets rather than letting each fleet spawn
+    its own full pool."""
+    F = cfg.actor_fleets
+    bounds = np.linspace(0, cfg.num_actors, F + 1).astype(int)
+    shards = [(int(lo), int(hi))
+              for lo, hi in zip(bounds[:-1], bounds[1:]) if lo < hi]
+    workers = (cfg.env_workers + F - 1) // F if cfg.env_workers else 0
+    return shards, workers
+
+
 def _resolve_act_device(spec: str):
     """Device for actor inference, or None to leave placement alone.
 

@@ -186,6 +186,86 @@ def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64,
     return fleets * per * iterations / dt
 
 
+def _bench_env_factory(cfg, seed):
+    """Module-level (picklable) fake-env factory: the process-transport
+    bench's spawn children unpickle it by reference."""
+    from r2d2_tpu.envs.fake import FakeAtariEnv
+
+    return FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=4,
+                        seed=seed, episode_len=500)
+
+
+def _actor_plane_bench_process(num_lanes: int = 64, fleets: int = 2,
+                               env_workers: int = 0,
+                               budget_s: float = 300.0):
+    """env-frames/s of the PROCESS-fleet actor plane on fake envs — the
+    same pong-scale workload as :func:`_actor_plane_bench`, through
+    ``parallel/actor_procs`` instead of in-process threads, so
+    tools/actor_scaling.py can put the thread-vs-process per-core slopes
+    side by side.
+
+    The trainer only observes block-granular arrivals, and a lockstep
+    fleet cuts ALL its lanes' blocks in the same iteration — arrivals are
+    periodic BURSTS (strictly alternating 400-step boundary cuts and
+    episode-truncation cuts at the fake env's 500-step episodes), so a
+    fixed wall window aliases against the burst phase.  Instead, per
+    fleet, frames are timed from the start of burst 0 to the start of
+    burst 2 — a stride of 2 spans exactly one full 500-step cut cycle —
+    which is phase-exact; the fleet rates sum to the plane rate.  Burst
+    boundaries are identified by COUNT, not wall-clock gaps (every burst
+    is exactly one block per lane, in order), so the alignment holds at
+    any host speed.  Children's jax-import + act-fn compile happens
+    before their first burst and is never charged."""
+    import jax
+
+    from r2d2_tpu.config import pong_config
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane
+    from r2d2_tpu.utils.math import epsilon_ladder
+    from r2d2_tpu.utils.store import ParamStore
+
+    cfg = pong_config(game_name="Fake", num_actors=num_lanes,
+                      env_workers=env_workers, actor_fleets=fleets,
+                      actor_transport="process")
+    net = create_network(cfg, 4)
+    store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
+    eps = [epsilon_ladder(i, num_lanes) for i in range(num_lanes)]
+    plane = ProcessFleetPlane(cfg, 4, _bench_env_factory, eps)
+    F = plane.num_fleets
+    # a burst = one block per lane, so burst k starts at event index k*L
+    lanes = [spec.hi - spec.lo for spec in plane.specs]
+    need = [2 * L + 1 for L in lanes]     # through burst 2's first block
+    events = [[] for _ in range(F)]       # per fleet: (t, frames)
+
+    def noop_sink(block, prios, episode_reward):
+        pass
+
+    try:
+        plane.start(store)
+        deadline = time.time() + budget_s
+        while (time.time() < deadline
+               and any(len(ev) < n for ev, n in zip(events, need))):
+            got = plane.ingest_once(noop_sink, timeout=0.2)
+            if got is None:
+                continue
+            src, n = got
+            events[src].append((time.perf_counter(), n))
+    finally:
+        plane.shutdown()
+
+    rate = 0.0
+    for src in range(F):
+        ev, L = events[src], lanes[src]
+        if len(ev) < need[src]:
+            raise RuntimeError(
+                f"fleet{src} produced {len(ev)}/{need[src]} blocks in "
+                f"{budget_s:.0f} s; need one full cut cycle for a "
+                "phase-exact window")
+        frames = sum(n for _, n in ev[0:2 * L])
+        rate += frames / (ev[2 * L][0] - ev[0][0])
+    return rate
+
+
 def _system_bench(wall_seconds: float, *, device_replay: bool = True,
                   superstep_k: int = 4, num_actors: int = 64,
                   env_workers: int = 0, superstep_pipeline: int = 2,

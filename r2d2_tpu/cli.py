@@ -67,6 +67,8 @@ def build_config(args: argparse.Namespace) -> Config:
         kw["game_name"] = args.game
     if args.actors is not None:
         kw["num_actors"] = args.actors
+    if getattr(args, "actor_transport", None):
+        kw["actor_transport"] = args.actor_transport
     if args.training_steps is not None:
         kw["training_steps"] = args.training_steps
     if args.seed is not None:
@@ -85,6 +87,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--preset", choices=sorted(_PRESETS), default="default")
     p.add_argument("--game", default=None, help="ALE game name, or 'Fake'")
     p.add_argument("--actors", type=int, default=None)
+    p.add_argument("--actor-transport", choices=("thread", "process"),
+                   default=None,
+                   help="experience-generation transport: 'thread' (one "
+                        "process, fleet threads; default) or 'process' "
+                        "(subprocess fleets over a shared-memory block "
+                        "channel — use for GIL-bound envs / many cores)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--training-steps", type=int, default=None)
     p.add_argument("--set", dest="overrides", action="append",

@@ -107,6 +107,20 @@ class Config:
                                       # train.py:30-34, regrouped); lanes
                                       # split contiguously, ladder epsilons
                                       # stay global
+    actor_transport: str = "thread"   # "thread": fleets are threads in the
+                                      # trainer process (scales only when
+                                      # the env releases the GIL);
+                                      # "process": each fleet is a
+                                      # subprocess (parallel/actor_procs),
+                                      # blocks return over preallocated
+                                      # shared-memory slabs and weights
+                                      # arrive on a versioned publication
+                                      # queue — the reference's N-process
+                                      # topology (train.py:30-34) in
+                                      # TPU-native form, for GIL-bound
+                                      # envs / multi-core hosts.  Fleet
+                                      # inference runs on the host CPU
+                                      # backend in this mode.
     device_replay: bool = False       # replay data lives in HBM; batches
                                       # are gathered in-graph (device_ring)
     device_ring_layout: str = "auto"  # "replicated" (full ring per device)
@@ -207,6 +221,10 @@ class Config:
             raise ValueError(
                 f"actor_fleets ({self.actor_fleets}) must be in "
                 f"[1, num_actors={self.num_actors}]")
+        if self.actor_transport not in ("thread", "process"):
+            raise ValueError(
+                f"unknown actor_transport {self.actor_transport!r} "
+                "(expected 'thread' or 'process')")
         if self.superstep_k < 1:
             raise ValueError("superstep_k must be >= 1")
         if self.superstep_pipeline < 0:

@@ -272,7 +272,11 @@ def make_super_step(cfg: Config, net: R2D2Network, k: int):
 
 def _compensated_cumsum(x):
     """Prefix sums of ``x`` (f32) with double-float (two-sum) carries —
-    each output is the f64-accurate prefix correctly rounded to f32.
+    near-f64 accuracy, validated against an f64 oracle.  (Not "correctly
+    rounded": the compensated operator is not exactly associative, so
+    ``associative_scan``'s tree shapes can differ from a sequential
+    double-float sum by a final-rounding ulp or two — far below stratum
+    -boundary resolution, which is what the oracle tests pin.)
 
     The host SumTree accumulates node sums in float64
     (replay/sum_tree.py); a plain f32 ``jnp.cumsum`` over the ~50k-leaf
@@ -280,9 +284,12 @@ def _compensated_cumsum(x):
     boundaries relative to the host tree's.  Carrying the rounding error
     in a second f32 lane (error-free two-sum, folded back each step)
     removes the accumulated drift while staying pure f32 — portable to
-    TPU, where f64 support is not guaranteed.  Verified 0/512 stratum
-    -boundary disagreements vs an np.float64 oracle across 8 seeds
-    (tests/test_in_graph_per.py::test_compensated_cumsum_matches_f64)."""
+    TPU, where f64 support is not guaranteed.  Verified 0 stratum
+    -boundary disagreements vs an np.float64 oracle across seeds, incl.
+    adversarial 1e-6/1e3 mixed-priority spreads at the largest per-slab
+    leaf count a v5e ring holds
+    (tests/test_in_graph_per.py::test_compensated_cumsum_matches_f64,
+    ::test_compensated_cumsum_adversarial_spread_per_slab)."""
 
     def dd_add(a, b):
         ah, al = a

@@ -1,0 +1,546 @@
+"""Process-fleet actor plane: multi-core experience generation.
+
+The threaded actor plane (train.py, ``cfg.actor_fleets`` threads) only
+scales across cores when the env releases the GIL inside ``step`` — real
+ALE does, but any GIL-bound env (pure-Python simulators, wrapped
+interpreters) pins the whole fleet to one core.  This module restores the
+reference's only genuinely parallel mechanism — N actor *processes*
+(train.py:30-34) — in TPU-native form:
+
+- **N subprocess fleets** (``cfg.actor_fleets`` of them, spawn-started so
+  no initialized JAX runtime is ever forked), each running the same
+  lockstep :class:`~r2d2_tpu.actor.VectorActor` over its contiguous shard
+  of the env lanes, with batched CPU inference and the global ladder
+  epsilons — learning semantics identical to the thread transport.
+- **Shared-memory block channel**: finished experience blocks return to
+  the trainer over preallocated ``multiprocessing.shared_memory`` slabs
+  laid out per :func:`~r2d2_tpu.replay.block.block_slot_spec` (the replay
+  ring's own per-block layout).  Only a tuple-of-ints shape header
+  crosses the metadata queue — bulk observation arrays are NEVER pickled
+  (the reference pickles every block through an mp.Queue,
+  worker.py:124-129).  Slot recycling over a free-list queue gives
+  natural backpressure: a fleet that outruns the trainer's ingest blocks
+  on the free list, not on unbounded pipe growth.  One channel per
+  fleet: a SIGKILLed process can die holding a queue's pipe lock, so
+  channels are fleet-private and retired wholesale on respawn.
+- **Versioned weight publication**: the trainer pumps each ParamStore
+  publish (as a host-numpy snapshot) to a small per-fleet queue; each
+  fleet republishes into its process-local ParamStore, so actors keep the
+  torn-read-free versioned-pull semantics of the thread transport
+  (utils/store.py) — no shared-memory weight mutation.
+- **Supervision**: the trainer runs a watchdog (under utils/supervisor's
+  Supervisor, like every other fabric thread) that detects a dead fleet
+  process and respawns it on the same lane shard — bounded by a restart
+  budget, after which the run stops instead of silently starving the
+  buffer.
+
+Fleet inference always runs on the host CPU backend (a subprocess must
+not touch the trainer's accelerator client); params arrive as host numpy
+and commit to the fleet's local device once per refresh
+(actor.VectorActor._refresh_params).
+
+``cfg.actor_transport = "process"`` wires this through ``train()``;
+``"thread"`` (the default) keeps the single-process fabric.  The env
+factory must be picklable (a module-level function / functools.partial)
+— spawn re-imports it in the child.
+"""
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing as mp
+import time
+from multiprocessing import shared_memory
+from queue import Empty, Full
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.replay.block import (
+    Block,
+    block_slot_spec,
+    read_block,
+    slot_layout,
+    slot_views,
+    write_block,
+)
+
+# sink(block, priorities, episode_reward_or_None) — the trainer-side
+# consumer of the channel (ReplayBuffer.add in train()).
+BlockSink = Callable[[Block, np.ndarray, Optional[float]], None]
+
+
+class FleetStopped(Exception):
+    """Raised inside a fleet's sink when the plane is shutting down —
+    unwinds the actor loop instead of blocking on a free slot forever."""
+
+
+class ShmBlockChannel:
+    """Trainer-side end of ONE fleet's block transport.
+
+    Owns one shared-memory segment of ``num_slots`` preallocated
+    max-shape block slots plus two small index queues: ``free`` (slot
+    numbers available to the producer) and ``ready`` (slot + shape header
+    + episode reward, posted by the producer).  ``recv`` hands back
+    zero-copy Block views into the slab; the caller must :meth:`release`
+    the slot after consuming them (ReplayBuffer.add copies/stages the
+    bytes before returning, so release-after-add is safe).
+
+    One channel per fleet — deliberately NOT shared: a SIGKILLed process
+    can die holding an mp.Queue pipe lock (the documented multiprocessing
+    caveat), which would wedge every other user of that queue forever.
+    Fleet-private channels confine the damage, and the watchdog retires
+    the whole channel with the dead process (ProcessFleetPlane._spawn).
+    """
+
+    def __init__(self, cfg: Config, action_dim: int, num_slots: int, ctx):
+        self.spec = block_slot_spec(cfg, action_dim)
+        self.slot_nbytes, self.offsets = slot_layout(self.spec)
+        self.num_slots = num_slots
+        self.shm = shared_memory.SharedMemory(
+            create=True, size=num_slots * self.slot_nbytes)
+        self.free = ctx.Queue()
+        self.ready = ctx.Queue()
+        for i in range(num_slots):
+            self.free.put(i)
+
+    def producer_info(self) -> Tuple[str, Any, Any]:
+        """The picklable handle a fleet child needs to attach
+        (:class:`ShmBlockProducer`): segment name + the two queues."""
+        return (self.shm.name, self.free, self.ready)
+
+    def _views(self, slot: int) -> dict:
+        return slot_views(self.shm.buf, self.spec, self.offsets,
+                          self.slot_nbytes, slot)
+
+    def recv(self, timeout: float = 0.1
+             ) -> Optional[Tuple[Block, np.ndarray, Optional[float], int,
+                                 int]]:
+        """One finished block, or None when nothing is ready (timeout
+        <= 0: non-blocking).  Returns ``(block, priorities,
+        episode_reward, slot, src)`` — src is the producing fleet's id;
+        block/priorities are views into the slab, valid until
+        ``release(slot)``."""
+        try:
+            if timeout <= 0:
+                slot, src, k, n_obs, n_steps, ep = self.ready.get_nowait()
+            else:
+                slot, src, k, n_obs, n_steps, ep = self.ready.get(
+                    timeout=timeout)
+        except Empty:
+            return None
+        block, prios = read_block(self._views(slot), k, n_obs, n_steps)
+        return block, prios, ep, slot, src
+
+    def release(self, slot: int) -> None:
+        self.free.put(slot)
+
+    def close(self) -> None:
+        self.shm.close()
+        try:
+            self.shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+class ShmBlockProducer:
+    """Fleet-side end of the block transport (lives in the subprocess).
+
+    ``send`` has the :data:`~r2d2_tpu.actor.BlockSink` signature, so it
+    plugs straight into a VectorActor.  Waiting for a free slot is the
+    transport's backpressure; the wait polls ``stop_event`` so shutdown
+    never hangs a fleet mid-block (raises :class:`FleetStopped`)."""
+
+    def __init__(self, cfg: Config, action_dim: int,
+                 info: Tuple[str, Any, Any], stop_event, src: int = 0):
+        name, self.free, self.ready = info
+        self.src = src
+        # NOTE: attaching registers the segment with the resource tracker
+        # a second time; that is a set-dedup no-op because fleet children
+        # are spawned via mp.Process and share the trainer's tracker —
+        # the trainer's single unlink at channel close balances it.
+        self.shm = shared_memory.SharedMemory(name=name)
+        self.spec = block_slot_spec(cfg, action_dim)
+        self.slot_nbytes, self.offsets = slot_layout(self.spec)
+        self.stop_event = stop_event
+
+    def send(self, block: Block, priorities: np.ndarray,
+             episode_reward: Optional[float]) -> None:
+        while True:
+            if self.stop_event.is_set():
+                raise FleetStopped
+            try:
+                slot = self.free.get(timeout=0.2)
+                break
+            except Empty:
+                continue
+        views = slot_views(self.shm.buf, self.spec, self.offsets,
+                           self.slot_nbytes, slot)
+        k, n_obs, n_steps = write_block(views, block, priorities)
+        self.ready.put((slot, self.src, k, n_obs, n_steps, episode_reward))
+
+    def close(self) -> None:
+        try:
+            self.shm.close()
+        except Exception:
+            pass
+
+
+@dataclasses.dataclass
+class _FleetSpec:
+    """Picklable per-fleet parameters shipped to the spawn child."""
+    fleet_id: int
+    lo: int                 # global lane range [lo, hi)
+    hi: int
+    epsilons: Tuple[float, ...]   # the GLOBAL ladder slice for these lanes
+    env_workers: int
+    incarnation: int = 0    # bumped per watchdog respawn: the replacement
+                            # must not replay its predecessor's env seeds
+                            # and exploration stream (near-duplicate
+                            # trajectories into the PER buffer)
+
+
+def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
+                       spec: _FleetSpec, producer_info, weights_q,
+                       stop_event) -> None:
+    """Entry point of one fleet subprocess.
+
+    Pins JAX to the host CPU backend before any backend init (the child
+    must never attach to the trainer's accelerator), waits for the
+    initial weight publication, then runs the standard lockstep
+    VectorActor with the shm producer as its sink until ``stop_event``.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import threading
+
+    from r2d2_tpu.actor import VectorActor, make_act_fn
+    from r2d2_tpu.models.network import create_network
+    from r2d2_tpu.utils.store import ParamStore
+
+    store = ParamStore()
+    deadline = time.time() + 120.0
+    first = None
+    while first is None and not stop_event.is_set():
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"fleet{spec.fleet_id}: no initial weights within 120 s")
+        try:
+            first = weights_q.get(timeout=0.2)
+        except Empty:
+            continue
+    if first is None:  # stopped before the first publication
+        return
+    store.publish(first[1])
+
+    def weight_drain():
+        while not stop_event.is_set():
+            try:
+                _version, params = weights_q.get(timeout=0.2)
+            except Empty:
+                continue
+            store.publish(params)
+
+    threading.Thread(target=weight_drain, daemon=True,
+                     name=f"fleet{spec.fleet_id}-weights").start()
+
+    producer = ShmBlockProducer(cfg, action_dim, producer_info, stop_event,
+                                src=spec.fleet_id)
+    net = create_network(cfg, action_dim)
+    act_fn = make_act_fn(cfg, net)
+    # incarnation shifts both the env seeds and the exploration stream so
+    # a respawned fleet explores fresh trajectories instead of replaying
+    # the ones its dead predecessor already contributed
+    envs = [env_factory(cfg, cfg.seed + i + 1_000_003 * spec.incarnation)
+            for i in range(spec.lo, spec.hi)]
+    actor = VectorActor(cfg, envs, list(spec.epsilons), act_fn, store,
+                        sink=producer.send, env_workers=spec.env_workers,
+                        rng=np.random.default_rng(
+                            cfg.seed + 7919 + 104729 * spec.fleet_id
+                            + 15_485_863 * spec.incarnation))
+    try:
+        while not stop_event.is_set():
+            actor.run(max_steps=256, stop=stop_event.is_set)
+    except FleetStopped:
+        pass
+    finally:
+        actor.close()
+        for e in envs:
+            try:
+                e.close()
+            except Exception:
+                pass
+        producer.close()
+
+
+class ProcessFleetPlane:
+    """The trainer-side orchestrator of the subprocess actor fleets.
+
+    Lifecycle: construct in ``train._build`` (no processes yet), then
+    ``start(param_store)`` spawns the fleets, and the three loops from
+    :meth:`make_loops` run under the fabric Supervisor:
+
+    - ``fleet_ingest``: drains the block channel into the replay buffer
+      (the same-thread analogue of the thread transport's direct
+      ``sink=buffer.add``).
+    - ``param_pump``: forwards new ParamStore versions to every fleet
+      (throttled — at most ~5 snapshots/s regardless of the learner's
+      publish cadence).
+    - ``fleet_watch``: respawns dead fleet processes on their lane shard,
+      up to ``max_restarts`` per fleet; an exhausted budget raises, which
+      the Supervisor escalates to a fabric stop instead of a silent
+      starve.
+
+    ``shutdown()`` stops the fleets (event + join, terminate as a last
+    resort) and unlinks the shared memory.  Each fleet owns a private
+    channel and weight queue, both retired and recreated whenever its
+    process is respawned — a process SIGKILLed mid-queue-operation can
+    corrupt that queue's pipe lock, and replacing the fleet's whole
+    channel confines the damage to the blocks it had in flight (which
+    are dropped, like any crash-lost experience).
+    """
+
+    SLOTS_PER_FLEET = 4   # in-flight blocks per fleet channel
+
+    def __init__(self, cfg: Config, action_dim: int, env_factory,
+                 epsilons: Sequence[float], max_restarts: int = 3):
+        from r2d2_tpu.actor import fleet_shards
+
+        self.cfg = cfg
+        self.action_dim = action_dim
+        self.env_factory = env_factory
+        self.max_restarts = max_restarts
+        self.ctx = mp.get_context("spawn")
+
+        shards, fleet_workers = fleet_shards(cfg)
+        self.specs = [
+            _FleetSpec(f, lo, hi, tuple(float(e) for e in epsilons[lo:hi]),
+                       fleet_workers)
+            for f, (lo, hi) in enumerate(shards)
+        ]
+        F = len(self.specs)
+        self.channels: List[Optional[ShmBlockChannel]] = [None] * F
+        self._graveyard: List[ShmBlockChannel] = []
+        self.stop_event = self.ctx.Event()
+        self.weight_queues: List[Any] = [None] * F
+        self.procs: List[Optional[mp.Process]] = [None] * F
+        self.restarts = [0] * F
+        self.failed = False
+        self.param_store = None
+        self._pumped_version = 0
+        self._rr = 0              # ingest round-robin cursor
+        self.blocks_ingested = 0
+        self.frames_ingested = 0
+        self.blocks_per_fleet = [0] * F
+
+    @property
+    def num_fleets(self) -> int:
+        return len(self.specs)
+
+    # ------------------------------------------------------------ weights
+    def _snapshot_params(self):
+        """Latest published params as a host-numpy pytree, or None."""
+        import jax
+
+        version, params = self.param_store.get()
+        if params is None:
+            return None, 0
+        return jax.device_get(params), version
+
+    def _prime(self, f: int, payload) -> None:
+        """Best-effort put of a weight snapshot to fleet ``f``'s queue,
+        displacing a stale one if the queue is full."""
+        version, host = payload
+        q = self.weight_queues[f]
+        try:
+            q.put_nowait((version, host))
+        except Full:
+            try:
+                q.get_nowait()
+            except Empty:
+                pass
+            try:
+                q.put_nowait((version, host))
+            except Full:
+                pass
+
+    def pump_params_once(self) -> bool:
+        """Forward the current ParamStore version to every fleet if it is
+        newer than the last pumped one.  Returns True if it pumped."""
+        version, _ = self.param_store.get()
+        if version == self._pumped_version:
+            return False
+        host, version = self._snapshot_params()
+        if host is None:
+            return False
+        for f in range(self.num_fleets):
+            self._prime(f, (version, host))
+        self._pumped_version = version
+        return True
+
+    # ------------------------------------------------------------- fleets
+    def _spawn(self, f: int, payload=None) -> None:
+        """(Re)provision fleet ``f``: a FRESH channel and weight queue,
+        weight priming, then the process spawn.  A SIGKILLed predecessor
+        can die holding one of its queues' pipe locks (the documented
+        mp.Queue caveat), so its channel is retired wholesale and never
+        reused — corruption cannot outlive the process that caused it.
+        The retired segment stays mapped until shutdown (the ingest
+        thread may still hold views into it); its in-flight blocks are
+        dropped, like any crash-lost experience.
+
+        ``payload`` is a prefetched ``(version, host_params)`` weight
+        snapshot (start() shares one across all fleets rather than
+        paying F device→host transfers); None re-snapshots — the
+        watchdog respawn path, where the predecessor consumed the queued
+        snapshot and the version may not have changed."""
+        old = self.channels[f]
+        if old is not None:
+            try:
+                old.shm.unlink()  # name freed now; mapping lives on
+            except FileNotFoundError:
+                pass
+            self._graveyard.append(old)
+        self.channels[f] = ShmBlockChannel(self.cfg, self.action_dim,
+                                           self.SLOTS_PER_FLEET, self.ctx)
+        self.weight_queues[f] = self.ctx.Queue(maxsize=2)
+        # prime BEFORE start so the child finds its initial weights
+        if payload is None:
+            host, version = self._snapshot_params()
+            payload = (version, host)
+        if payload[1] is not None:
+            self._prime(f, payload)
+        spec = dataclasses.replace(self.specs[f],
+                                   incarnation=self.restarts[f])
+        p = self.ctx.Process(
+            target=_fleet_worker_main, name=f"fleet{f}",
+            args=(self.cfg, self.action_dim, self.env_factory, spec,
+                  self.channels[f].producer_info(), self.weight_queues[f],
+                  self.stop_event),
+            daemon=True)
+        p.start()
+        self.procs[f] = p
+
+    def start(self, param_store) -> None:
+        """Spawn every fleet.  ``param_store`` must already hold the
+        initial publication (Learner.__init__ publishes v1)."""
+        self.param_store = param_store
+        # ONE device→host transfer shared by every fleet's priming
+        host, version = self._snapshot_params()
+        self._pumped_version = version
+        for f in range(self.num_fleets):
+            self._spawn(f, payload=(version, host))
+
+    def watch_once(self) -> int:
+        """Respawn any dead fleet process (skipped while shutting down).
+        Returns the number of restarts performed; raises RuntimeError —
+        after marking the plane failed — once a fleet exhausts its
+        budget, so the supervised watchdog escalates to a fabric stop."""
+        restarted = 0
+        if self.stop_event.is_set():
+            return 0
+        for f, p in enumerate(self.procs):
+            if p is None or p.is_alive():
+                continue
+            if self.restarts[f] >= self.max_restarts:
+                self.failed = True
+                raise RuntimeError(
+                    f"fleet{f} died (exitcode {p.exitcode}) with its "
+                    f"restart budget ({self.max_restarts}) exhausted")
+            self.restarts[f] += 1
+            restarted += 1
+            self._spawn(f)
+        return restarted
+
+    # ------------------------------------------------------------- ingest
+    def ingest_once(self, sink: BlockSink, timeout: float = 0.1
+                    ) -> Optional[Tuple[int, int]]:
+        """Deliver at most one block channel→``sink``, polling every
+        fleet's channel round-robin (non-blocking; sleeps ``timeout``
+        when all are empty).  Returns ``(src, frames)`` for a consumed
+        block, else None."""
+        F = self.num_fleets
+        for k in range(F):
+            f = (self._rr + k) % F
+            # snapshot the channel AND its owning process together: the
+            # watchdog may respawn the fleet between these reads, and a
+            # corrupt-pipe error from the retired channel must be judged
+            # against the process that owned it, not its replacement
+            ch = self.channels[f]
+            p = self.procs[f]
+            if ch is None:
+                continue
+            try:
+                got = ch.recv(timeout=0)
+            except Exception:
+                if (ch is not self.channels[f]
+                        or p is None or not p.is_alive()):
+                    # the dying producer corrupted its queue mid-write;
+                    # the watchdog retires this channel with it
+                    continue
+                raise
+            if got is None:
+                continue
+            block, prios, episode_reward, slot, src = got
+            try:
+                sink(block, prios, episode_reward)
+            finally:
+                ch.release(slot)
+            self._rr = (f + 1) % F
+            frames = block.action.shape[0]
+            self.blocks_ingested += 1
+            self.frames_ingested += frames
+            if 0 <= src < len(self.blocks_per_fleet):
+                self.blocks_per_fleet[src] += 1
+            return (src, frames)
+        if timeout > 0:
+            time.sleep(timeout)
+        return None
+
+    def make_loops(self, stop: Callable[[], bool], sink: BlockSink):
+        """The plane's three supervised fabric loops for ``train()``."""
+
+        def fleet_ingest():
+            while not stop():
+                self.ingest_once(sink)
+
+        def param_pump():
+            while not stop():
+                self.pump_params_once()
+                time.sleep(0.2)
+
+        def fleet_watch():
+            while not stop():
+                self.watch_once()
+                time.sleep(0.25)
+
+        return [("fleet_ingest", fleet_ingest), ("param_pump", param_pump),
+                ("fleet_watch", fleet_watch)]
+
+    def health(self) -> dict:
+        return dict(
+            fleets=self.num_fleets,
+            alive=sum(1 for p in self.procs
+                      if p is not None and p.is_alive()),
+            restarts=list(self.restarts),
+            failed=self.failed,
+            blocks_ingested=self.blocks_ingested,
+            frames_ingested=self.frames_ingested,
+            blocks_per_fleet=list(self.blocks_per_fleet),
+        )
+
+    # ----------------------------------------------------------- shutdown
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self.stop_event.set()
+        for p in self.procs:
+            if p is None:
+                continue
+            p.join(timeout)
+            if p.is_alive():
+                p.terminate()
+                p.join(2.0)
+        for ch in list(self.channels) + self._graveyard:
+            if ch is not None:
+                ch.close()

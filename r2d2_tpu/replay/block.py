@@ -118,6 +118,102 @@ def assemble_block(cfg: Config, *, obs: np.ndarray, last_action: np.ndarray,
     return block, priorities
 
 
+# --------------------------------------------------------------------------
+# block <-> shared-memory slot (the process-fleet transport's wire format)
+# --------------------------------------------------------------------------
+
+def block_slot_spec(cfg: Config, action_dim: int):
+    """(name, max shape, dtype) of ONE preallocated block slot — the wire
+    format of the shared-memory block channel (parallel/actor_procs.py).
+
+    DERIVED from the replay ring's own layout (replay_buffer._data_spec /
+    _count_spec with the slot axis dropped) plus the actor-computed
+    initial priorities, so the wire format cannot drift from the ring a
+    future field/dtype change lands in: a fleet subprocess serialises a
+    Block with a handful of vectorised array copies and the trainer's
+    ingest reconstructs zero-copy views — bulk experience never goes
+    through pickle."""
+    # lazy import: replay_buffer imports this module (Block)
+    from r2d2_tpu.replay.replay_buffer import _count_spec, _data_spec
+
+    per_block = tuple((name, shape[1:], dtype)
+                      for name, shape, dtype in _data_spec(cfg, action_dim))
+    # of the accounting arrays, only the per-sequence windows travel;
+    # first_burn_in / block_learning_total are derived at add() time
+    windows = tuple((name, shape[1:], dtype)
+                    for name, shape, dtype in _count_spec(cfg)
+                    if name in ("burn_in_steps", "learning_steps",
+                                "forward_steps"))
+    return per_block + windows + (
+        ("priorities", (cfg.seqs_per_block,), np.float32),)
+
+
+def slot_layout(spec) -> Tuple[int, dict]:
+    """(slot_nbytes, {name: byte offset}) for a :func:`block_slot_spec`,
+    every array 8-byte aligned so the shm views are properly aligned for
+    their dtypes."""
+    offsets, off = {}, 0
+    for name, shape, dtype in spec:
+        off = (off + 7) & ~7
+        offsets[name] = off
+        off += int(np.prod(shape)) * np.dtype(dtype).itemsize
+    return (off + 7) & ~7, offsets
+
+
+def slot_views(buf, spec, offsets: dict, slot_nbytes: int, slot: int) -> dict:
+    """Numpy views of slot ``slot`` inside a shared-memory buffer — the
+    same call serves the producer (writes) and the consumer (zero-copy
+    reads)."""
+    base = slot * slot_nbytes
+    return {name: np.ndarray(shape, dtype=dtype, buffer=buf,
+                             offset=base + offsets[name])
+            for name, shape, dtype in spec}
+
+
+def write_block(views: dict, block: Block, priorities: np.ndarray
+                ) -> Tuple[int, int, int]:
+    """Serialise ``block`` into a slot's views.  Returns the shape header
+    ``(num_sequences, n_obs, n_steps)`` — the only thing that crosses the
+    metadata queue (a tuple of ints; the arrays travel through shm)."""
+    k = block.num_sequences
+    n_obs = block.obs.shape[0]
+    n_steps = block.action.shape[0]
+    views["obs"][:n_obs] = block.obs
+    views["last_action"][:n_obs] = block.last_action
+    views["last_reward"][:n_obs] = block.last_reward
+    views["action"][:n_steps] = block.action
+    views["n_step_reward"][:n_steps] = block.n_step_reward
+    views["n_step_gamma"][:n_steps] = block.n_step_gamma
+    views["hidden"][:k] = block.hidden
+    views["burn_in_steps"][:k] = block.burn_in_steps
+    views["learning_steps"][:k] = block.learning_steps
+    views["forward_steps"][:k] = block.forward_steps
+    views["priorities"][:] = priorities
+    return k, n_obs, n_steps
+
+
+def read_block(views: dict, k: int, n_obs: int, n_steps: int
+               ) -> Tuple[Block, np.ndarray]:
+    """Reconstruct ``(block, priorities)`` from a slot's views — zero
+    copy: the Block fields alias the shm slab, valid until the slot is
+    released back to the free list (ReplayBuffer.add copies them into the
+    ring / stages them to the device before that happens)."""
+    block = Block(
+        obs=views["obs"][:n_obs],
+        last_action=views["last_action"][:n_obs],
+        last_reward=views["last_reward"][:n_obs],
+        action=views["action"][:n_steps],
+        n_step_reward=views["n_step_reward"][:n_steps],
+        n_step_gamma=views["n_step_gamma"][:n_steps],
+        hidden=views["hidden"][:k],
+        num_sequences=k,
+        burn_in_steps=views["burn_in_steps"][:k],
+        learning_steps=views["learning_steps"][:k],
+        forward_steps=views["forward_steps"][:k],
+    )
+    return block, views["priorities"]
+
+
 class LocalBuffer:
     """Actor-side accumulator that cuts episodes into Blocks.
 

@@ -25,6 +25,13 @@ TPU-first redesign — one process, many threads, one device program:
 single-thread interleaving of the same components (the reference's
 semantics with ``num_actors`` lanes and no concurrency) used by the
 integration tests and useful for debugging.
+
+``cfg.actor_transport = "process"`` swaps the in-process actor threads
+for subprocess fleets (parallel/actor_procs.py): blocks come back over a
+preallocated shared-memory channel and weights go out on a versioned
+publication queue — the reference's N-process acting topology
+(train.py:30-34) for GIL-bound envs / multi-core hosts; the rest of the
+fabric (replay, learner, supervision) is unchanged.
 """
 from __future__ import annotations
 
@@ -36,7 +43,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
-from r2d2_tpu.actor import VectorActor, make_act_fn
+from r2d2_tpu.actor import VectorActor, fleet_shards, make_act_fn
 from r2d2_tpu.checkpoint import Checkpointer
 from r2d2_tpu.config import Config
 from r2d2_tpu.envs import create_env
@@ -59,9 +66,28 @@ def _default_env_factory(cfg: Config, seed: int):
 
 def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
            checkpoint_dir: Optional[str], resume: bool):
-    """Common bring-up: envs, net, state (maybe restored), buffer, stores."""
-    envs = [env_factory(cfg, cfg.seed + i) for i in range(cfg.num_actors)]
-    action_dim = envs[0].action_space.n
+    """Common bring-up: envs, net, state (maybe restored), buffer, stores.
+
+    Returns the EFFECTIVE config under ``"cfg"``: degrade paths (e.g.
+    ``in_graph_per`` without a ring) flip flags here, and ``train()``
+    must make its fabric decisions from the flipped config — stripping
+    the priority thread from the outer (un-flipped) config while the
+    learner runs the host-sampled path wedges the learner on a full,
+    undrained priority queue after ~its depth in updates.
+    """
+    if cfg.actor_transport == "process":
+        # the fleets own the envs in their subprocesses; the trainer only
+        # needs the action space to size the network/replay layouts
+        probe = env_factory(cfg, cfg.seed)
+        action_dim = probe.action_space.n
+        try:
+            probe.close()
+        except Exception:
+            pass
+        envs = []
+    else:
+        envs = [env_factory(cfg, cfg.seed + i) for i in range(cfg.num_actors)]
+        action_dim = envs[0].action_space.n
     net = create_network(cfg, action_dim)
     params = init_params(cfg, net, jax.random.PRNGKey(cfg.seed))
     state = create_train_state(cfg, params)
@@ -88,10 +114,6 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
     else:
         host_bs = cfg.batch_size
     param_store = ParamStore()
-    learner = Learner(cfg, net, state, mesh=mesh, param_store=param_store,
-                      checkpointer=checkpointer,
-                      start_env_steps=start_env_steps,
-                      start_minutes=start_minutes)
     ring = None
     if cfg.device_replay and jax.process_count() == 1:
         from r2d2_tpu.replay.device_ring import DeviceRing, resolve_layout
@@ -183,34 +205,47 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
             "shrink buffer_capacity to restore the device-PER plane",
             stacklevel=2)
         cfg = cfg.replace(in_graph_per=False)
+    # the learner is built AFTER the ring/in_graph_per decisions so it
+    # (and everything below) sees the effective config
+    learner = Learner(cfg, net, state, mesh=mesh, param_store=param_store,
+                      checkpointer=checkpointer,
+                      start_env_steps=start_env_steps,
+                      start_minutes=start_minutes)
     buffer = ReplayBuffer(cfg, action_dim,
                           rng=np.random.default_rng(cfg.seed),
                           device_ring=ring)
     buffer.env_steps = start_env_steps
-    act_fn = make_act_fn(cfg, net)
     epsilons = [epsilon_ladder(i, cfg.num_actors, cfg.base_eps, cfg.eps_alpha)
                 for i in range(cfg.num_actors)]
-    # actor_fleets independent lockstep fleets over contiguous lane slices:
-    # the ladder epsilons stay GLOBAL (lane i keeps epsilon_ladder(i, N)
-    # regardless of fleet count — the reference's per-actor ladder,
-    # train.py:15-17), and each fleet gets its own RNG stream and thread
-    # so one fleet's env stepping overlaps another's batched inference
-    F = cfg.actor_fleets
-    bounds = np.linspace(0, cfg.num_actors, F + 1).astype(int)
-    # the env-worker budget is a per-HOST tuning knob: split it across the
-    # fleets rather than letting each fleet spawn its own full pool (4
-    # fleets x 16 workers would 4x-oversubscribe the cores the knob was
-    # tuned for)
-    fleet_workers = (cfg.env_workers + F - 1) // F if cfg.env_workers else 0
-    actors = [
-        VectorActor(cfg, envs[lo:hi], epsilons[lo:hi], act_fn, param_store,
-                    sink=buffer.add, env_workers=fleet_workers,
-                    rng=np.random.default_rng(cfg.seed + 7919 + 104729 * f))
-        for f, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:]))
-        if lo < hi
-    ]
-    return dict(envs=envs, action_dim=action_dim, net=net, learner=learner,
-                buffer=buffer, actors=actors, actor=actors[0],
+    plane = None
+    if cfg.actor_transport == "process":
+        # subprocess fleets (parallel/actor_procs): constructed here, but
+        # processes only spawn in train() once the fabric is up
+        from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane
+
+        plane = ProcessFleetPlane(cfg, action_dim, env_factory, epsilons)
+        actors: List[VectorActor] = []
+    else:
+        act_fn = make_act_fn(cfg, net)
+        # actor_fleets independent lockstep fleets over contiguous lane
+        # slices (actor.fleet_shards — the split shared with the process
+        # transport): the ladder epsilons stay GLOBAL (lane i keeps
+        # epsilon_ladder(i, N) regardless of fleet count — the reference's
+        # per-actor ladder, train.py:15-17), and each fleet gets its own
+        # RNG stream and thread so one fleet's env stepping overlaps
+        # another's batched inference
+        shards, fleet_workers = fleet_shards(cfg)
+        actors = [
+            VectorActor(cfg, envs[lo:hi], epsilons[lo:hi], act_fn,
+                        param_store, sink=buffer.add,
+                        env_workers=fleet_workers,
+                        rng=np.random.default_rng(
+                            cfg.seed + 7919 + 104729 * f))
+            for f, (lo, hi) in enumerate(shards)
+        ]
+    return dict(cfg=cfg, envs=envs, action_dim=action_dim, net=net,
+                learner=learner, buffer=buffer, actors=actors,
+                actor=actors[0] if actors else None, plane=plane,
                 param_store=param_store,
                 checkpointer=checkpointer, host_bs=host_bs, ring=ring)
 
@@ -245,8 +280,9 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     # after every single update)
     cfg = cfg.replace(prefetch_batches=0, env_workers=0, actor_fleets=1,
                       device_replay=False, in_graph_per=False,
-                      superstep_pipeline=0)
+                      superstep_pipeline=0, actor_transport="thread")
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
+    cfg = sys["cfg"]
     actor: VectorActor = sys["actor"]
     buffer: ReplayBuffer = sys["buffer"]
     learner: Learner = sys["learner"]
@@ -311,9 +347,11 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     ``profile_dir`` captures a ``jax.profiler`` device trace of the run.
     """
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
+    cfg = sys["cfg"]  # the EFFECTIVE config (degrade paths flip flags)
     actors: List[VectorActor] = sys["actors"]
     buffer: ReplayBuffer = sys["buffer"]
     learner: Learner = sys["learner"]
+    plane = sys["plane"]
     tracer = tracer or Tracer()
     supervisor = Supervisor(max_restarts=max_thread_restarts)
 
@@ -382,6 +420,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 trace=tracer.snapshot(),
                 health=supervisor.health(),
             )
+            if plane is not None:
+                entry["fleet"] = plane.health()
             logs.append(entry)
             if log_sink is not None:
                 log_sink(entry)
@@ -396,6 +436,11 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     loops = [(f"actor{f}" if len(actors) > 1 else "actor",
               make_actor_loop(a)) for f, a in enumerate(actors)]
+    if plane is not None:
+        # process transport: fleets are subprocesses; their trainer-side
+        # plumbing (block ingest, weight pump, process watchdog) runs as
+        # supervised fabric threads just like the actor threads would
+        loops += plane.make_loops(stop, buffer.add)
     loops += [("sample", sample_loop), ("priority", priority_loop),
               ("log", log_loop)]
     if sys["ring"] is not None:
@@ -406,8 +451,6 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         # priority feedback never crosses the host (the super-step
         # scatters it on-device) — nothing would ever feed this queue
         loops = [(n, f) for n, f in loops if n != "priority"]
-    for name, loop in loops:
-        supervisor.start(name, loop)
 
     def batch_source():
         while not stop():
@@ -431,7 +474,15 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         # instead of silently dropping them
         buffer.update_priorities(idxes, priorities, old_ptr, loss)
 
+    # everything that launches concurrent machinery (fleet subprocesses,
+    # fabric threads) lives INSIDE the try: a failure anywhere in bring-up
+    # must still reach the teardown below, or a caller catching the
+    # exception is left with orphaned processes and /dev/shm slabs
     try:
+        if plane is not None:
+            plane.start(sys["param_store"])
+        for name, loop in loops:
+            supervisor.start(name, loop)
         with device_profile(profile_dir):
             if sys["ring"] is not None:
                 metrics = learner.run_device(buffer, sys["ring"],
@@ -445,6 +496,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         supervisor.join_all(timeout=5.0)
         for a in actors:
             a.close()
+        if plane is not None:
+            plane.shutdown()
 
     # drain remaining priority feedback so buffer counters are final
     while True:
@@ -458,5 +511,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                    buffer_training_steps=buffer.training_steps,
                    final_params=learner.state.params,
                    trace=tracer.snapshot(), health=supervisor.health(),
-                   fabric_failed=supervisor.any_failed)
+                   fabric_failed=(supervisor.any_failed
+                                  or (plane is not None and plane.failed)))
+    if plane is not None:
+        metrics["fleet_health"] = plane.health()
     return metrics

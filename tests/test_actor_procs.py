@@ -1,0 +1,194 @@
+"""Process-fleet actor plane (parallel/actor_procs.py): the shm block
+channel's wire format, fleet-process supervision (kill → respawn →
+bounded escalation), and the full ``train()`` fabric on
+``actor_transport="process"``.
+
+The env factory must live at module level: the spawn children unpickle it
+by reference (module + qualname), which is exactly the constraint the
+transport documents for production factories.
+"""
+import multiprocessing as mp
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.parallel.actor_procs import (
+    ProcessFleetPlane,
+    ShmBlockChannel,
+    ShmBlockProducer,
+)
+from r2d2_tpu.replay.block import LocalBuffer
+from r2d2_tpu.utils.store import ParamStore
+
+A = 4
+
+
+def make_fake_env(cfg, seed):
+    """Module-level (picklable) factory for the spawn children."""
+    return FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                        seed=seed, episode_len=32)
+
+
+def scripted_blocks(cfg, n_finishes, seed=0, partial_last=True):
+    """(block, priorities, episode_reward) triples from a scripted
+    LocalBuffer — the last one a short terminated episode chunk when
+    ``partial_last`` (exercises the trimmed shape header)."""
+    rng = np.random.default_rng(seed)
+    local = LocalBuffer(cfg, A)
+    local.reset(rng.integers(0, 256, cfg.stored_obs_shape, np.uint8))
+    out = []
+    for j in range(n_finishes):
+        partial = partial_last and j == n_finishes - 1
+        steps = max(1, cfg.block_length // 2 - 1) if partial \
+            else cfg.block_length
+        for _ in range(steps):
+            local.add(int(rng.integers(A)), float(rng.normal()),
+                      rng.integers(0, 256, cfg.stored_obs_shape, np.uint8),
+                      rng.normal(size=A).astype(np.float32),
+                      rng.normal(size=(2, cfg.lstm_layers, cfg.hidden_dim)
+                                 ).astype(np.float32))
+        if partial:
+            blk, prios, ep = local.finish(None)  # terminated → reward set
+        else:
+            blk, prios, ep = local.finish(
+                rng.normal(size=A).astype(np.float32))
+        out.append((blk, prios, ep))
+        if partial:
+            local.reset(rng.integers(0, 256, cfg.stored_obs_shape,
+                                     np.uint8))
+    return out
+
+
+def test_shm_channel_roundtrip_bit_exact():
+    """Blocks cross the channel bit-exact through the shm slabs; only the
+    tuple-of-ints shape header rides the metadata queue (bulk arrays are
+    views into the slab, never pickled)."""
+    cfg = make_test_config()
+    ctx = mp.get_context("spawn")
+    channel = ShmBlockChannel(cfg, A, num_slots=4, ctx=ctx)
+    producer = ShmBlockProducer(cfg, A, channel.producer_info(),
+                                ctx.Event(), src=5)
+    items = scripted_blocks(cfg, 3)
+    try:
+        for blk, prios, ep in items:
+            producer.send(blk, prios, ep)
+        for blk, prios, ep in items:
+            got = channel.recv(timeout=10.0)
+            assert got is not None, "channel dropped a block"
+            b2, p2, ep2, slot, src = got
+            assert src == 5
+            assert b2.num_sequences == blk.num_sequences
+            for f in ("obs", "last_action", "last_reward", "action",
+                      "n_step_reward", "n_step_gamma", "hidden",
+                      "burn_in_steps", "learning_steps", "forward_steps"):
+                a, b = getattr(blk, f), getattr(b2, f)
+                assert a.dtype == b.dtype and a.shape == b.shape, f
+                np.testing.assert_array_equal(a, b, err_msg=f)
+            np.testing.assert_array_equal(prios, p2)
+            assert ep2 == ep
+            channel.release(slot)
+        # every slot returned to the free list
+        assert channel.recv(timeout=0.1) is None
+    finally:
+        producer.close()
+        channel.close()
+
+
+def _drain_until(plane, sink, predicate, deadline_s):
+    deadline = time.time() + deadline_s
+    while not predicate() and time.time() < deadline:
+        plane.ingest_once(sink, timeout=0.2)
+    return predicate()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_fleet_process_killed_is_restarted_then_escalates():
+    """SIGKILLing a fleet process mid-run must lead to a watchdog respawn
+    on the same lane shard (blocks keep flowing), and an exhausted
+    restart budget must raise — the Supervisor escalation path — rather
+    than restart forever or hang.  slow: three subprocess spawns (each a
+    fresh CPython + jax import + act-fn compile) plus two long drain
+    budgets — the repo's multi-process marker policy."""
+    cfg = make_test_config(game_name="Fake", num_actors=2, actor_fleets=2,
+                           actor_transport="process")
+    net = create_network(cfg, A)
+    store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3],
+                              max_restarts=2)
+    got = []
+
+    def sink(block, prios, ep):
+        got.append(block.action.shape[0])
+
+    try:
+        plane.start(store)
+        assert _drain_until(plane, sink, lambda: len(got) >= 2, 120), \
+            "no blocks arrived from the fleet processes"
+
+        victim = plane.procs[0]
+        victim_channel = plane.channels[0]
+        victim.kill()
+        victim.join(10)
+        assert not victim.is_alive()
+
+        t0 = time.time()
+        while plane.watch_once() == 0:
+            assert time.time() < t0 + 30, "watchdog never saw the death"
+            time.sleep(0.1)
+        assert plane.restarts[0] == 1
+        assert plane.procs[0] is not victim and plane.procs[0].is_alive()
+        # the victim's channel was retired with it: a SIGKILL can corrupt
+        # the dead producer's queue locks, so the respawn must never
+        # reuse them
+        assert plane.channels[0] is not victim_channel
+
+        n0 = len(got)
+        assert _drain_until(plane, sink, lambda: len(got) >= n0 + 2, 120), \
+            "no blocks after the fleet respawn"
+
+        # exhaust the budget: the next death must escalate, not respawn
+        plane.restarts[0] = plane.max_restarts
+        plane.procs[0].kill()
+        plane.procs[0].join(10)
+        with pytest.raises(RuntimeError, match="restart budget"):
+            plane.watch_once()
+        assert plane.failed
+    finally:
+        plane.shutdown()
+    assert all(p is None or not p.is_alive() for p in plane.procs)
+
+
+@pytest.mark.timeout(600)
+def test_train_process_transport_end_to_end():
+    """The acceptance path: ``train()`` with two fleet subprocesses on
+    CPU — blocks reach the replay buffer over the shm channel, the
+    learner consumes them, priority feedback is fully applied, and the
+    fabric shuts down clean.  Kept in the default (tier-1) run as the
+    transport's living proof — ~25 s on an idle host; the explicit
+    timeout gives contended hosts headroom over the 300 s default, and
+    train()'s own max_wall_seconds bounds a genuine wedge well inside
+    it."""
+    from r2d2_tpu.train import train
+
+    cfg = make_test_config(game_name="Fake", num_actors=4, actor_fleets=2,
+                           actor_transport="process", training_steps=6,
+                           log_interval=0.2)
+    m = train(cfg, env_factory=make_fake_env, max_wall_seconds=240,
+              verbose=False)
+    assert m["num_updates"] >= cfg.training_steps
+    assert np.isfinite(m["mean_loss"])
+    assert not m["fabric_failed"]
+    assert m["buffer_training_steps"] == m["num_updates"]
+    fleet = m["fleet_health"]
+    assert fleet["fleets"] == 2
+    assert fleet["alive"] == 0          # shutdown reaped every process
+    assert fleet["blocks_ingested"] > 0
+    assert fleet["frames_ingested"] >= m["buffer_size"]
+    # BOTH fleet processes contributed experience to the buffer
+    assert all(c > 0 for c in fleet["blocks_per_fleet"])

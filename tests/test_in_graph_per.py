@@ -317,6 +317,46 @@ def test_compensated_cumsum_matches_f64():
     assert plain_diffs > 0  # the plain-f32 drift this guards against
 
 
+def test_compensated_cumsum_adversarial_spread_per_slab():
+    """The in-graph sampler's worst case (VERDICT r5 #7): the largest
+    per-slab leaf count a v5e ring supports, under adversarial mixed
+    priority spreads (1e-6 leaves sprinkled among 1e3 leaves, with
+    padding zeros) — 0 stratum disagreements vs the f64 oracle.
+
+    A plain f32 cumsum accumulates O(n·eps·total) drift here (~5
+    absolute at these magnitudes), swallowing the tiny leaves' mass and
+    shifting large-leaf boundaries; the compensated scan must hold every
+    stratum boundary at oracle resolution."""
+    from r2d2_tpu.config import pong_config
+    from r2d2_tpu.learner.step import _compensated_cumsum
+    from r2d2_tpu.replay.replay_buffer import data_bytes
+
+    # leaf capacity of one v5e chip (16 GB HBM, 80% budget — the ring
+    # guard's own threshold) at flagship Pong shapes: ~40k leaves/slab
+    cfg = pong_config()
+    per_block = data_bytes(cfg, 6) // cfg.num_blocks
+    n_blocks = int(0.8 * 16e9) // per_block
+    N = int(n_blocks * cfg.seqs_per_block)
+    assert N >= 30_000  # sanity: flagship scale, not a toy
+
+    fn = jax.jit(_compensated_cumsum)
+    diffs = 0
+    for seed in range(8):
+        rng = np.random.default_rng(100 + seed)
+        x = np.full(N, 1e-6, np.float32)      # near-converged TD errors
+        x[rng.random(N) < 0.05] = 1e3         # fresh high-surprise blocks
+        x[rng.random(N) < 0.3] = 0.0          # padding / empty slots
+        ref = np.cumsum(x.astype(np.float64))
+        hi = np.asarray(fn(jnp.asarray(x)))
+        u = rng.random(64)                    # one stratum per batch row
+        t64 = (np.arange(64) + u) * (ref[-1] / 64)
+        t32 = ((np.arange(64, dtype=np.float32) + u.astype(np.float32))
+               * (hi[-1].astype(np.float32) / np.float32(64)))
+        diffs += int(np.sum(np.searchsorted(ref, t64, side="right")
+                            != np.searchsorted(hi, t32, side="right")))
+    assert diffs == 0
+
+
 def dp_filled(cfg, n_blocks=8, seed=0):
     """A dp-layout ring + buffer with every slab populated."""
     from r2d2_tpu.parallel.mesh import make_mesh
@@ -445,14 +485,30 @@ def test_train_degrades_in_graph_per_without_ring(monkeypatch):
     """The flagship presets default in_graph_per=True; on a host whose
     device budget rejects the ring, train() must warn and continue on
     host-sampled PER (the reference's behavior is host replay, never a
-    crash).  Forced here by making every ring look too big."""
+    crash).  Forced here by making every ring look too big.
+
+    Regression (ADVICE r5 high): _build used to flip in_graph_per only on
+    its LOCAL cfg, so train() still stripped the priority thread while
+    the learner took the host-sampled path — after ~8 updates (the
+    priority queue depth) the undrained queue wedged the learner forever.
+    training_steps=16 runs past that depth plus the superstep pipeline,
+    and the host tree must carry real priority mass with the feedback
+    counter fully applied, so the wedge can never regress silently."""
     import importlib
     import warnings
 
     train_mod = importlib.import_module("r2d2_tpu.train")
 
+    built = {}
+
+    class SpyBuffer(ReplayBuffer):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            built["buffer"] = self
+
     monkeypatch.setattr(train_mod, "_device_memory_bytes", lambda: 1)
-    cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=4,
+    monkeypatch.setattr(train_mod, "ReplayBuffer", SpyBuffer)
+    cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=16,
                    log_interval=0.2)
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
@@ -465,6 +521,13 @@ def test_train_degrades_in_graph_per_without_ring(monkeypatch):
     assert metrics["num_updates"] >= cfg.training_steps
     assert np.isfinite(metrics["mean_loss"])
     assert not metrics["fabric_failed"]
+    # the degraded run's PER plane is the HOST tree: actor-side priorities
+    # landed in it (mass > 0 — in_graph mode keeps it exactly empty), and
+    # every learner update's feedback came back through the priority
+    # thread (the path the stripped-thread wedge starved)
+    buf = built["buffer"]
+    assert buf.tree.total > 0.0
+    assert metrics["buffer_training_steps"] == metrics["num_updates"] >= 16
 
 
 def test_train_sync_accepts_in_graph_preset():

@@ -1,17 +1,19 @@
-"""Actor-plane scaling measurement: frames/s vs env_workers / actor_fleets.
+"""Actor-plane scaling measurement: frames/s vs transport / workers / fleets.
 
-Answers VERDICT r3 item 6: how does the actor plane scale with the two
-host-parallelism knobs, per core, and is device-side acting worth it?
-Sweeps bench._actor_plane_bench (the SAME measurement as the headline
-bench — no reimplementation to drift) over a grid of ``env_workers``
-(thread-pool env stepping inside one fleet) and ``fleets`` (independent
-lockstep fleets, train.py's actor_fleets split).
+Answers VERDICT r3 item 6 (host-parallelism slopes) and the r6 tentpole's
+go/no-go: does the PROCESS-fleet transport (parallel/actor_procs, the
+reference's N-process topology over a shared-memory block channel) beat
+the thread transport per core on this host?  Sweeps the SAME measurement
+as the headline bench — bench._actor_plane_bench for threads,
+bench._actor_plane_bench_process for subprocess fleets — so nothing is
+reimplemented to drift.
 
-Default run is CPU-pinned and writes the host-scaling table to
-artifacts/r05/ACTOR_SCALING_r05.json.  ``--device`` leaves the default backend alone
-and measures ONLY the act_device cells (CPU twin vs on-device acting),
-merging them into the existing artifact instead of re-measuring — and
-overwriting — the CPU-pinned table with a different backend active.
+Default run is CPU-pinned and writes the scaling table to
+artifacts/r06/ACTOR_SCALING_r06.json.  ``--device`` leaves the default
+backend alone and measures ONLY the act_device cells (CPU twin vs
+on-device acting), merging them into the existing artifact instead of
+re-measuring — and overwriting — the CPU-pinned table with a different
+backend active.
 """
 import json
 import os
@@ -24,36 +26,58 @@ if not DEVICE_MODE:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # the process fleets pin themselves to CPU either way; this env var
+    # covers any other subprocess the measurement spawns
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax  # noqa: E402
 
-from r2d2_tpu.bench import _actor_plane_bench  # noqa: E402
+from r2d2_tpu.bench import (  # noqa: E402
+    _actor_plane_bench,
+    _actor_plane_bench_process,
+)
 
 ITERS = 300
-PATH = "artifacts/r05/ACTOR_SCALING_r05.json"
+PATH = "artifacts/r06/ACTOR_SCALING_r06.json"
 
 
 def cell(env_workers: int, fleets: int, act_device: str = "auto") -> dict:
     fps = _actor_plane_bench(iterations=ITERS, env_workers=env_workers,
                              act_device=act_device, fleets=fleets)
-    print(f"env_workers={env_workers} fleets={fleets} act={act_device}: "
+    print(f"transport=thread env_workers={env_workers} fleets={fleets} "
+          f"act={act_device}: {fps:,.0f} frames/s", flush=True)
+    return dict(transport="thread", env_workers=env_workers,
+                actor_fleets=fleets, act_device=act_device,
+                backend=jax.default_backend(), frames_per_sec=round(fps, 1))
+
+
+def pcell(fleets: int, env_workers: int = 0) -> dict:
+    # burst-aligned measurement (see _actor_plane_bench_process): exact
+    # over one full block-cut cycle per fleet, immune to burst phase
+    fps = _actor_plane_bench_process(fleets=fleets, env_workers=env_workers)
+    print(f"transport=process env_workers={env_workers} fleets={fleets}: "
           f"{fps:,.0f} frames/s", flush=True)
-    return dict(env_workers=env_workers, actor_fleets=fleets,
-                act_device=act_device, backend=jax.default_backend(),
-                frames_per_sec=round(fps, 1))
+    return dict(transport="process", env_workers=env_workers,
+                actor_fleets=fleets, act_device="cpu",
+                backend=jax.default_backend(), frames_per_sec=round(fps, 1))
 
 
 def main() -> None:
+    os.makedirs(os.path.dirname(PATH), exist_ok=True)
     prior = json.load(open(PATH)) if os.path.exists(PATH) else dict(
         host_cpus=os.cpu_count() or 0, lanes=64, iterations=ITERS,
+        process_measure="burst-aligned, one full cut cycle per fleet",
         results=[])
     if DEVICE_MODE:
         # the go/no-go cells only: CPU twin vs acting on the accelerator,
         # appended to the existing host table
         results = [cell(0, 1, "auto"), cell(0, 1, "default")]
     else:
-        results = [cell(w, f) for w, f in
-                   [(0, 1), (2, 1), (4, 1), (8, 1), (0, 2), (0, 4), (2, 2)]]
+        # thread-vs-process slope on whatever cores exist: matched fleet
+        # counts on both transports, plus the env-worker knob inside one
+        # fleet for the thread side
+        results = ([cell(w, f) for w, f in [(0, 1), (2, 1), (0, 2), (0, 4)]]
+                   + [pcell(f) for f in (1, 2, 4)])
     prior["results"] = prior.get("results", []) + results
     with open(PATH, "w") as f:
         json.dump(prior, f, indent=1)
