@@ -249,6 +249,66 @@ class VectorActor:
             self._params = params
             self._param_version = version
 
+    # ------------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """Resumable actor state for the full-state checkpoint: exploration
+        RNG, per-lane episode lifecycle, batched agent state, the local
+        block-assembly buffers, and — for envs that support ALE-style
+        ``clone_state()`` — the env emulator state itself.
+
+        Call only while the actor is quiescent (between :meth:`run` bursts
+        / after the fabric stopped): the arrays are not lock-protected.
+        Lanes whose env cannot snapshot are restored by reset — their
+        in-progress episode is the only loss."""
+        env_states = []
+        for e in self.envs:
+            fn = getattr(e, "clone_state", None)
+            try:
+                env_states.append(fn() if callable(fn) else None)
+            except Exception:
+                env_states.append(None)
+        return dict(
+            num_lanes=self.N,
+            rng=self.rng.bit_generator.state,
+            actor_steps=int(self.actor_steps),
+            episode_steps=self.episode_steps.copy(),
+            finish_pending=self.finish_pending.copy(),
+            agent=dict(obs=self.obs.copy(), last_action=self.last_action.copy(),
+                       last_reward=self.last_reward.copy(),
+                       hidden=self.hidden.copy()),
+            vbuf=self.vbuf.snapshot(),
+            env_states=env_states,
+        )
+
+    def restore(self, snap: dict) -> None:
+        """Resume from a :meth:`snapshot`.  Lanes with a captured env state
+        continue their episode (and in-progress block) mid-stream; the
+        rest are reset.  Raises ValueError on a lane-count mismatch (the
+        caller warns and resumes cold)."""
+        if int(snap["num_lanes"]) != self.N:
+            raise ValueError(
+                f"actor snapshot has {snap['num_lanes']} lanes, this actor "
+                f"has {self.N} — resuming cold")
+        self.rng.bit_generator.state = snap["rng"]
+        self.actor_steps = int(snap["actor_steps"])
+        self.episode_steps[:] = snap["episode_steps"]
+        self.finish_pending[:] = snap["finish_pending"]
+        # belt over the sink-unwind ordering above: a deferred cut is only
+        # meaningful for a lane with an unfinished block
+        self.finish_pending &= np.asarray(snap["vbuf"]["size"]) > 0
+        agent = snap["agent"]
+        self.obs[:] = agent["obs"]
+        self.last_action[:] = agent["last_action"]
+        self.last_reward[:] = agent["last_reward"]
+        self.hidden[:] = agent["hidden"]
+        self.vbuf.load_snapshot(snap["vbuf"])
+        for i, st in enumerate(snap["env_states"]):
+            fn = getattr(self.envs[i], "restore_state", None)
+            if st is not None and callable(fn):
+                fn(st)
+            else:
+                self._reset_lane(i)  # env can't resume: fresh episode
+
     def _step_shard(self, lanes: range, actions: np.ndarray) -> None:
         """Env-step a contiguous lane shard (the only per-lane Python left
         in the hot loop — the gym API is per-env; ALE releases the GIL in
@@ -290,8 +350,13 @@ class VectorActor:
             # state is the bootstrap value (worker.py:550-554 semantics,
             # without the second forward)
             for i in np.nonzero(self.finish_pending)[0]:
-                self.sink(*self.vbuf.finish(i, q[i]))
+                # clear BEFORE the sink call: a sink that unwinds mid-
+                # delivery (FleetStopped during shutdown) must leave the
+                # lane consistent — vbuf already finished, flag cleared —
+                # or a snapshot taken now would re-finish an empty lane
+                # at resume
                 self.finish_pending[i] = False
+                self.sink(*self.vbuf.finish(i, q[i]))
 
             explore = self.rng.random(self.N) < self.epsilons
             actions = np.where(explore,
@@ -320,8 +385,13 @@ class VectorActor:
 
             done_lanes = np.nonzero(self._step_done)[0]
             for i in done_lanes:
-                self.sink(*self.vbuf.finish(i, None))
+                # reset BEFORE the sink call (the finished Block owns
+                # copies, never vbuf storage): a sink that unwinds during
+                # shutdown must leave the lane consistent for the
+                # shutdown snapshot — same ordering as the boundary cut
+                item = self.vbuf.finish(i, None)
                 self._reset_lane(i)
+                self.sink(*item)
 
             capped = np.nonzero(~self._step_done
                                 & (self.episode_steps >= cfg.max_episode_steps)
@@ -341,8 +411,9 @@ class VectorActor:
                                          self.hidden)
                 q_fresh = np.asarray(q_fresh)
                 for i in capped:
-                    self.sink(*self.vbuf.finish(i, q_fresh[i]))
-                    self._reset_lane(i)
+                    item = self.vbuf.finish(i, q_fresh[i])
+                    self._reset_lane(i)  # before the sink; see done_lanes
+                    self.sink(*item)
 
             self.actor_steps += 1
             if self.actor_steps % cfg.actor_update_interval == 0:

@@ -5,17 +5,29 @@ The reference saves ``(state_dict, num_updates, env_steps, minutes)`` every
 restarts from scratch.  This module beats that (SURVEY.md §5.4): orbax
 checkpoints of the full :class:`TrainState` (params, target params, opt
 state, step counter) plus a metadata sidecar, with true bit-exact resume.
+
+Preemption-safe on top (ISSUE 2): restore only ever selects COMPLETE
+steps (the sidecar commits last, so a crash mid-save is invisible);
+``save_replay``/``restore_replay`` persist the full replay plane — ring
+bytes, sum-tree leaves, counters, actor snapshots — atomically
+(tmp dir + rename, ``meta.json`` commits last); ``keep`` bounds disk via
+retention GC that never touches in-progress saves; and a chaos hook lets
+drills truncate a save mid-write to prove the skip path
+(docs/OPERATIONS.md runbook).
 """
 from __future__ import annotations
 
 import json
 import os
+import pickle
 import re
-from typing import Any, Dict, Optional, Tuple
+import shutil
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import orbax.checkpoint as ocp
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_REPLAY_RE = re.compile(r"^step_(\d+)\.replay$")
 
 
 class Checkpointer:
@@ -26,8 +38,17 @@ class Checkpointer:
     can sweep checkpoints without touching device state.
     """
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, keep: int = 0):
+        """``keep`` > 0: after each successful save, garbage-collect all
+        but the newest ``keep`` COMPLETE checkpoints (their replay
+        snapshots with them).  In-progress saves — step dirs whose sidecar
+        has not landed yet — are never collected.  0 keeps everything."""
         self.directory = os.path.abspath(directory)
+        self.keep = keep
+        # optional utils.chaos.ChaosInjector: lets drills/soaks simulate a
+        # crash mid-save ("truncate_ckpt") — the orbax dir is truncated and
+        # the sidecar never written, exercising the restore-skip path
+        self.chaos = None
         os.makedirs(self.directory, exist_ok=True)
         # Explicit Checkpointer+handler composition instead of the
         # deprecated ``PyTreeCheckpointer`` shortcut.  NOT
@@ -44,6 +65,9 @@ class Checkpointer:
     def _meta_path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}.meta.json")
 
+    def _replay_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}.replay")
+
     def save(self, step: int, state: Any,
              meta: Optional[Dict[str, Any]] = None) -> None:
         """Multihost: call from EVERY process — orbax coordinates its own
@@ -54,6 +78,11 @@ class Checkpointer:
         import jax
 
         if jax.process_index() == 0:
+            if self.chaos is not None and self.chaos.fire("truncate_ckpt"):
+                # injected crash mid-save: chop the payload and skip the
+                # sidecar — restore must never select this step
+                truncate_checkpoint_dir(path)
+                return
             # atomic: the follow-mode evaluator gates on this file's
             # existence and reads it immediately — it must never observe
             # a partially written sidecar
@@ -62,19 +91,47 @@ class Checkpointer:
             with open(tmp, "w") as f:
                 json.dump(dict(meta or {}, step=step), f)
             os.replace(tmp, meta_path)
+            self._gc()
 
-    def steps(self) -> list:
-        """All checkpointed steps, ascending."""
+    def steps(self, complete: bool = True) -> list:
+        """Checkpointed steps, ascending.  ``complete=True`` (default)
+        lists only steps whose meta sidecar exists: the sidecar commits
+        last, so a crash mid-save leaves a ``step_N/`` dir with no sidecar
+        that must never be selected for restore (it would fail on — or
+        silently load — a torn orbax payload)."""
         out = []
         for name in os.listdir(self.directory):
             m = _STEP_RE.match(name)
             if m and os.path.isdir(os.path.join(self.directory, name)):
-                out.append(int(m.group(1)))
+                step = int(m.group(1))
+                if complete and not self.has_meta(step):
+                    continue
+                out.append(step)
         return sorted(out)
 
     def latest_step(self) -> Optional[int]:
+        """Newest COMPLETE step (sidecar present), or None."""
         steps = self.steps()
         return steps[-1] if steps else None
+
+    def _gc(self) -> None:
+        """Retention: drop all but the newest ``keep`` complete
+        checkpoints.  Only complete steps are candidates — a dir without a
+        sidecar is an in-progress save (possibly another process's) and is
+        never collected."""
+        if self.keep <= 0:
+            return
+        for step in self.steps()[:-self.keep]:
+            # sidecar FIRST: once it is gone the step can no longer be
+            # selected for restore, so a crash mid-GC can't leave a
+            # selectable half-deleted checkpoint
+            for p in (self._meta_path(step),):
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass
+            shutil.rmtree(self._path(step), ignore_errors=True)
+            shutil.rmtree(self._replay_path(step), ignore_errors=True)
 
     def has_meta(self, step: int) -> bool:
         """Whether ``step``'s metadata sidecar exists.  Process 0 writes it
@@ -105,6 +162,118 @@ class Checkpointer:
             with open(self._meta_path(step)) as f:
                 meta = json.load(f)
         return state, meta
+
+    # ------------------------------------------------------ replay snapshot
+    def save_replay(self, step: int, writer: Callable[[str], Dict[str, Any]],
+                    actors: Optional[Any] = None) -> None:
+        """Write the full replay snapshot for ``step`` atomically.
+
+        ``writer(ring_path)`` serialises the payload (ReplayBuffer
+        .write_state) and returns its JSON-able meta; ``actors`` is the
+        per-fleet actor snapshot list (pickled alongside — checkpoint
+        artifact, not a hot-path transport).  Everything lands in a tmp
+        dir with ``meta.json`` committed last INSIDE it, then one rename
+        publishes the dir — a crash at any point leaves either the old
+        snapshot or an ignorable ``*.tmp*`` dir, never a torn snapshot
+        (restore_replay only considers dirs whose meta.json exists)."""
+        final = self._replay_path(step)
+        tmp = f"{final}.tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        try:
+            meta = dict(writer(os.path.join(tmp, "ring.bin")), step=step,
+                        has_actors=actors is not None)
+            if actors is not None:
+                with open(os.path.join(tmp, "actors.pkl"), "wb") as f:
+                    pickle.dump(actors, f)
+            if self.chaos is not None and self.chaos.fire("truncate_ckpt"):
+                return  # injected crash: the partial tmp dir IS the drill
+            mtmp = os.path.join(tmp, "meta.json.tmp")
+            with open(mtmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(mtmp, os.path.join(tmp, "meta.json"))
+            shutil.rmtree(final, ignore_errors=True)
+            os.replace(tmp, final)
+            # replay snapshots are ring-sized (GBs at flagship scale):
+            # keep only the newest ``max(1, keep)`` — periodic cadence
+            # snapshots must never accumulate unboundedly, and restore
+            # always takes the latest anyway.  Ordered by COMMIT TIME,
+            # not step: step counters regress across runs sharing a dir
+            # (fresh run, failed replay restore), and a step-ordered
+            # prune would delete the snapshot it just wrote while
+            # keeping a stale high-step one
+            for _, _, path in self._replay_entries()[:-max(1, self.keep)]:
+                shutil.rmtree(path, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    def _replay_entries(self) -> list:
+        """COMPLETE replay snapshots as ``(commit mtime, step, path)``,
+        oldest first.  meta.json commits last, so its mtime is the
+        snapshot's publication time."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _REPLAY_RE.match(name)
+            if not m:
+                continue
+            meta = os.path.join(self.directory, name, "meta.json")
+            try:
+                mtime = os.path.getmtime(meta)
+            except OSError:  # partial snapshot: no meta.json
+                continue
+            out.append((mtime, int(m.group(1)),
+                        os.path.join(self.directory, name)))
+        return sorted(out)
+
+    def replay_steps(self) -> list:
+        """Steps with a COMPLETE replay snapshot (meta.json present),
+        ascending."""
+        return sorted(s for _, s, _ in self._replay_entries())
+
+    def restore_replay(self, step: Optional[int] = None
+                       ) -> Optional[Tuple[Dict[str, Any], str, Any]]:
+        """Latest (or ``step``'s) complete replay snapshot as
+        ``(meta, ring_path, actor_snapshots_or_None)``, or None when no
+        complete snapshot exists.  "Latest" means most recently COMMITTED
+        (meta.json mtime), which stays correct when step counters regress
+        across runs sharing a checkpoint dir.  Partial snapshots (no
+        meta.json — a crash mid-write) are never selected."""
+        entries = self._replay_entries()
+        if step is None:
+            if not entries:
+                return None
+            step = entries[-1][1]
+        elif step not in [s for _, s, _ in entries]:
+            return None
+        path = self._replay_path(step)
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        actors = None
+        if meta.get("has_actors"):
+            with open(os.path.join(path, "actors.pkl"), "rb") as f:
+                actors = pickle.load(f)
+        return meta, os.path.join(path, "ring.bin"), actors
+
+
+def truncate_checkpoint_dir(path: str) -> None:
+    """Simulate a crash mid-save: truncate the largest file under ``path``
+    to half its size (the torn-payload shape a real preemption leaves).
+    Chaos drills only — the restore path must skip such a step because its
+    sidecar never landed."""
+    largest, size = None, -1
+    for root, _, files in os.walk(path):
+        for name in files:
+            p = os.path.join(root, name)
+            try:
+                s = os.path.getsize(p)
+            except OSError:
+                continue
+            if s > size:
+                largest, size = p, s
+    if largest is not None:
+        with open(largest, "r+b") as f:
+            f.truncate(max(0, size // 2))
 
 
 # config fields that change parameter shapes; recorded in the checkpoint

@@ -111,7 +111,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     pt = sub.add_parser("train", help="run distributed training")
     _add_common(pt)
     pt.add_argument("--resume", action="store_true",
-                    help="resume from the latest checkpoint in --ckpt-dir")
+                    help="resume from the latest COMPLETE checkpoint in "
+                         "--ckpt-dir (partial saves from a crash are "
+                         "skipped); with a full-state replay snapshot "
+                         "present, the replay ring, sum-tree and actor "
+                         "RNG/env state resume warm too")
+    pt.add_argument("--keep-checkpoints", type=int, default=None,
+                    metavar="N",
+                    help="retain only the newest N complete checkpoints "
+                         "(+ replay snapshots); default keeps all")
+    pt.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="fault-injection drill spec (utils/chaos.py), "
+                         "e.g. 'kill_fleet:every=500;garble_block:p=0.01' "
+                         "— overrides cfg.chaos_spec")
     pt.add_argument("--mesh", action="store_true",
                     help="data-parallel learner over all visible devices")
     pt.add_argument("--distributed", action="store_true",
@@ -169,6 +181,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "train":
         from r2d2_tpu.train import train, train_sync
 
+        try:
+            if args.keep_checkpoints is not None:
+                cfg = cfg.replace(keep_checkpoints=args.keep_checkpoints)
+            if args.chaos is not None:
+                cfg = cfg.replace(chaos_spec=args.chaos)
+        except ValueError as e:
+            parser.error(str(e))
         if args.sync and args.max_wall_seconds is not None:
             parser.error("--max-wall-seconds is not supported with --sync "
                          "(the deterministic trainer runs to training_steps)")

@@ -158,6 +158,39 @@ class Config:
                                       # the device-replay learning presets
                                       # turn it ON — see pong_config's
                                       # rationale
+    # --- robustness / recovery (SURVEY §5.3-grade, no reference equivalent)
+    keep_checkpoints: int = 0         # >0: after each successful save, GC
+                                      # all but the newest N COMPLETE
+                                      # checkpoints (+ their replay
+                                      # snapshots); in-progress saves are
+                                      # never collected.  0 keeps all
+    replay_snapshot: bool = True      # full-state recovery: at shutdown
+                                      # (incl. SIGTERM/SIGINT drain) write
+                                      # the replay ring + sum-tree +
+                                      # counters + actor RNG/env state
+                                      # next to the learner checkpoint so
+                                      # --resume restarts with a warm
+                                      # buffer.  Host-ring buffers only;
+                                      # device_replay runs persist learner
+                                      # state alone (docs/OPERATIONS.md)
+    replay_snapshot_interval: float = 0.0  # seconds between periodic
+                                      # replay snapshots mid-run (0 = only
+                                      # at shutdown).  Periodic snapshots
+                                      # capture the buffer consistently
+                                      # (its lock) but skip thread-
+                                      # transport actor state — the warm
+                                      # ring is the expensive asset a
+                                      # kill -9 must not lose
+    learner_stall_timeout: float = 0.0  # >0: a heartbeat watchdog declares
+                                      # the learner stalled after this
+                                      # many seconds without a loop
+                                      # iteration and stops the fabric
+                                      # (set it above the worst-case XLA
+                                      # compile; 0 disables)
+    chaos_spec: str = ""              # deterministic fault injection
+                                      # (utils/chaos.py), e.g.
+                                      # "kill_fleet:every=500;garble_block:p=0.01"
+                                      # — drills/soaks only; "" disables
     fused_double_unroll: bool = False  # compute the online+target forwards
                                       # as ONE unroll vmapped over stacked
                                       # params: half the sequential LSTM
@@ -248,6 +281,18 @@ class Config:
             raise ValueError(f"unknown lstm_impl {self.lstm_impl!r} "
                              "(pallas_spmd was retired in r5 with the "
                              "backward kernel — training always scans)")
+        if self.keep_checkpoints < 0:
+            raise ValueError("keep_checkpoints must be >= 0 (0 keeps all)")
+        if self.replay_snapshot_interval < 0:
+            raise ValueError("replay_snapshot_interval must be >= 0")
+        if self.learner_stall_timeout < 0:
+            raise ValueError("learner_stall_timeout must be >= 0")
+        if self.chaos_spec:
+            # fail at construction, not mid-run: parse_spec raises on an
+            # unknown kind/param or a clause without a trigger
+            from r2d2_tpu.utils.chaos import parse_spec
+
+            parse_spec(self.chaos_spec)
         if self.stored_hidden_mode not in ("burn_in_start", "seq_start"):
             raise ValueError(
                 f"unknown stored_hidden_mode {self.stored_hidden_mode!r}")

@@ -76,5 +76,17 @@ class FakeAtariEnv:
             reward += 2.0  # exercises episode-end accounting distinctly
         return self._obs(), reward, terminated, truncated, {}
 
+    def clone_state(self) -> dict:
+        """ALE-style resumable emulator state (actor full-state snapshots
+        — VectorActor.snapshot): RNG + phase + step counter is the whole
+        dynamics, so restore continues the episode bit-exactly."""
+        return dict(rng=self._rng.bit_generator.state, phase=self._phase,
+                    t=self._t)
+
+    def restore_state(self, state: dict) -> None:
+        self._rng.bit_generator.state = state["rng"]
+        self._phase = int(state["phase"])
+        self._t = int(state["t"])
+
     def close(self):
         pass

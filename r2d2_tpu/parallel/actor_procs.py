@@ -55,15 +55,20 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import logging
+
 from r2d2_tpu.config import Config
 from r2d2_tpu.replay.block import (
     Block,
     block_slot_spec,
     read_block,
+    slot_crc,
     slot_layout,
     slot_views,
     write_block,
 )
+
+log = logging.getLogger(__name__)
 
 # sink(block, priorities, episode_reward_or_None) — the trainer-side
 # consumer of the channel (ReplayBuffer.add in train()).
@@ -73,6 +78,17 @@ BlockSink = Callable[[Block, np.ndarray, Optional[float]], None]
 class FleetStopped(Exception):
     """Raised inside a fleet's sink when the plane is shutting down —
     unwinds the actor loop instead of blocking on a free slot forever."""
+
+
+class CorruptBlockError(Exception):
+    """A ready slot failed its CRC32 integrity check (torn producer write
+    or garbled slab).  The slot has already been released back to the free
+    list; the caller drops the block and counts it."""
+
+    def __init__(self, slot: int, src: int):
+        super().__init__(f"block slot {slot} from fleet {src} failed CRC32")
+        self.slot = slot
+        self.src = src
 
 
 class ShmBlockChannel:
@@ -129,7 +145,14 @@ class ShmBlockChannel:
                     timeout=timeout)
         except Empty:
             return None
-        block, prios = read_block(self._views(slot), k, n_obs, n_steps)
+        views = self._views(slot)
+        # integrity gate: the producer writes the CRC32 word LAST, so a
+        # torn write (SIGKILL mid-slot) or a garbled slab cannot reach the
+        # replay ring as silently-wrong experience
+        if int(views["crc32"][0]) != slot_crc(views, k, n_obs, n_steps):
+            self.release(slot)
+            raise CorruptBlockError(slot, src)
+        block, prios = read_block(views, k, n_obs, n_steps)
         return block, prios, ep, slot, src
 
     def release(self, slot: int) -> None:
@@ -202,13 +225,21 @@ class _FleetSpec:
 
 def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                        spec: _FleetSpec, producer_info, weights_q,
-                       stop_event) -> None:
+                       stop_event, ctrl_q=None, snap_q=None,
+                       restore_snap=None) -> None:
     """Entry point of one fleet subprocess.
 
     Pins JAX to the host CPU backend before any backend init (the child
     must never attach to the trainer's accelerator), waits for the
     initial weight publication, then runs the standard lockstep
     VectorActor with the shm producer as its sink until ``stop_event``.
+
+    ``ctrl_q``/``snap_q`` are the snapshot control channel: a "snapshot"
+    request is answered — between run bursts, and once more during
+    shutdown — with ``(fleet_id, VectorActor.snapshot())`` so the trainer
+    can persist resumable actor state (checkpoint.save_replay).
+    ``restore_snap`` resumes a previously-captured snapshot at spawn
+    (full-state --resume).
     """
     import jax
 
@@ -260,12 +291,42 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                         rng=np.random.default_rng(
                             cfg.seed + 7919 + 104729 * spec.fleet_id
                             + 15_485_863 * spec.incarnation))
+    if restore_snap is not None:
+        try:
+            actor.restore(restore_snap)
+        except Exception as e:  # geometry changed: resume cold, don't die
+            log.warning("fleet%d: actor snapshot not restored (%s) — "
+                        "resuming cold", spec.fleet_id, e)
+
+    def answer_ctrl(timeout: float) -> None:
+        """Answer one pending control request; the actor is quiescent
+        between run bursts, so the snapshot is consistent."""
+        try:
+            req = (ctrl_q.get(timeout=timeout) if timeout > 0
+                   else ctrl_q.get_nowait())
+        except Empty:
+            return
+        if req == "snapshot":
+            snap_q.put((spec.fleet_id, actor.snapshot()))
+
     try:
         while not stop_event.is_set():
             actor.run(max_steps=256, stop=stop_event.is_set)
+            if ctrl_q is not None:
+                answer_ctrl(0.0)
     except FleetStopped:
         pass
     finally:
+        if ctrl_q is not None:
+            # shutdown handshake: the trainer always sends one final
+            # request ("snapshot" for a drain-then-save exit, "bye"
+            # otherwise — ProcessFleetPlane.shutdown), so a preempted run
+            # can capture resumable actor state on its way down; the
+            # timeout bounds an orphaned worker whose trainer died
+            try:
+                answer_ctrl(3.0)
+            except Exception:
+                pass
         actor.close()
         for e in envs:
             try:
@@ -325,6 +386,8 @@ class ProcessFleetPlane:
         self._graveyard: List[ShmBlockChannel] = []
         self.stop_event = self.ctx.Event()
         self.weight_queues: List[Any] = [None] * F
+        self.ctrl_queues: List[Any] = [None] * F   # snapshot requests out
+        self.snap_queues: List[Any] = [None] * F   # snapshots back
         self.procs: List[Optional[mp.Process]] = [None] * F
         self.restarts = [0] * F
         self.failed = False
@@ -333,7 +396,14 @@ class ProcessFleetPlane:
         self._rr = 0              # ingest round-robin cursor
         self.blocks_ingested = 0
         self.frames_ingested = 0
+        self.blocks_corrupt = 0   # CRC-failed blocks dropped at ingest
+        self.on_corrupt: Optional[Callable[[], None]] = None
         self.blocks_per_fleet = [0] * F
+        # one-shot per-fleet actor snapshots applied at the FIRST spawn
+        # (full-state --resume); watchdog respawns start fresh — replaying
+        # checkpoint-old RNG would re-contribute near-duplicate
+        # trajectories the ring already holds
+        self._restore_snaps: List[Optional[dict]] = [None] * F
 
     @property
     def num_fleets(self) -> int:
@@ -406,6 +476,10 @@ class ProcessFleetPlane:
         self.channels[f] = ShmBlockChannel(self.cfg, self.action_dim,
                                            self.SLOTS_PER_FLEET, self.ctx)
         self.weight_queues[f] = self.ctx.Queue(maxsize=2)
+        # fleet-private like every other queue (SIGKILL corruption must
+        # not cross fleets); fresh per spawn for the same reason
+        self.ctrl_queues[f] = self.ctx.Queue()
+        self.snap_queues[f] = self.ctx.Queue()
         # prime BEFORE start so the child finds its initial weights
         if payload is None:
             host, version = self._snapshot_params()
@@ -414,14 +488,31 @@ class ProcessFleetPlane:
             self._prime(f, payload)
         spec = dataclasses.replace(self.specs[f],
                                    incarnation=self.restarts[f])
+        restore_snap, self._restore_snaps[f] = self._restore_snaps[f], None
         p = self.ctx.Process(
             target=_fleet_worker_main, name=f"fleet{f}",
             args=(self.cfg, self.action_dim, self.env_factory, spec,
                   self.channels[f].producer_info(), self.weight_queues[f],
-                  self.stop_event),
+                  self.stop_event, self.ctrl_queues[f], self.snap_queues[f],
+                  restore_snap),
             daemon=True)
         p.start()
         self.procs[f] = p
+
+    def set_restore_snapshots(self, snaps: Optional[Sequence[Optional[dict]]]
+                              ) -> None:
+        """Arm per-fleet actor snapshots (checkpoint.restore_replay
+        payload) to be applied at the first spawn of each fleet.  A
+        fleet-count mismatch resumes cold with a warning — lane shards
+        changed, so old per-fleet state no longer maps."""
+        if not snaps:
+            return
+        if len(snaps) != self.num_fleets:
+            log.warning(
+                "actor snapshots cover %d fleets but the plane has %d — "
+                "resuming actors cold", len(snaps), self.num_fleets)
+            return
+        self._restore_snaps = list(snaps)
 
     def start(self, param_store) -> None:
         """Spawn every fleet.  ``param_store`` must already hold the
@@ -474,6 +565,14 @@ class ProcessFleetPlane:
                 continue
             try:
                 got = ch.recv(timeout=0)
+            except CorruptBlockError as e:
+                # torn/garbled slot: the slot is already back on the free
+                # list — drop the block, count it, surface it, move on
+                self.blocks_corrupt += 1
+                if self.on_corrupt is not None:
+                    self.on_corrupt()
+                log.warning("dropped corrupt block: %s", e)
+                continue
             except Exception:
                 if (ch is not self.channels[f]
                         or p is None or not p.is_alive()):
@@ -528,12 +627,43 @@ class ProcessFleetPlane:
             failed=self.failed,
             blocks_ingested=self.blocks_ingested,
             frames_ingested=self.frames_ingested,
+            blocks_corrupt=self.blocks_corrupt,
             blocks_per_fleet=list(self.blocks_per_fleet),
         )
 
     # ----------------------------------------------------------- shutdown
-    def shutdown(self, timeout: float = 10.0) -> None:
+    def shutdown(self, timeout: float = 10.0, snapshot: bool = False
+                 ) -> Optional[List[Optional[dict]]]:
+        """Stop the fleets (event + final control message + join,
+        terminate as a last resort) and unlink the shared memory.
+
+        ``snapshot=True`` — the drain-then-save exit — asks every live
+        fleet for its resumable actor snapshot on the way down (answered
+        from the worker's shutdown handshake) and returns the per-fleet
+        list (None entries for fleets that died or timed out); otherwise
+        returns None."""
         self.stop_event.set()
+        live = [f for f, p in enumerate(self.procs)
+                if p is not None and p.is_alive()]
+        for f in live:
+            try:
+                self.ctrl_queues[f].put_nowait(
+                    "snapshot" if snapshot else "bye")
+            except Exception:
+                pass
+        snaps: Optional[List[Optional[dict]]] = None
+        if snapshot:
+            snaps = [None] * self.num_fleets
+            deadline = time.time() + timeout
+            for f in live:
+                try:
+                    fid, snap = self.snap_queues[f].get(
+                        timeout=max(0.1, deadline - time.time()))
+                    if fid == self.specs[f].fleet_id:
+                        snaps[f] = snap
+                except Exception:
+                    log.warning("fleet%d: no shutdown snapshot within "
+                                "budget — it will resume cold", f)
         for p in self.procs:
             if p is None:
                 continue
@@ -544,3 +674,4 @@ class ProcessFleetPlane:
         for ch in list(self.channels) + self._graveyard:
             if ch is not None:
                 ch.close()
+        return snaps

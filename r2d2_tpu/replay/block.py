@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import zlib
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -145,7 +146,34 @@ def block_slot_spec(cfg: Config, action_dim: int):
                     if name in ("burn_in_steps", "learning_steps",
                                 "forward_steps"))
     return per_block + windows + (
-        ("priorities", (cfg.seqs_per_block,), np.float32),)
+        ("priorities", (cfg.seqs_per_block,), np.float32),
+        # integrity word: CRC32 over the slot's used payload bytes + the
+        # shape header, written LAST by the producer.  A torn write (a
+        # producer SIGKILLed mid-slot) or garbled slab shows up as a
+        # mismatch at ingest, where the trainer drops the block instead of
+        # feeding torn experience to the learner (actor_procs.ingest_once).
+        ("crc32", (1,), np.uint32),)
+
+
+# (field, used-length selector) pairs of the payload a slot CRC covers —
+# shared by the producer (write_block) and the verifying consumer so the
+# two can never drift
+_CRC_FIELDS = (("obs", "n_obs"), ("last_action", "n_obs"),
+               ("last_reward", "n_obs"), ("action", "n_steps"),
+               ("n_step_reward", "n_steps"), ("n_step_gamma", "n_steps"),
+               ("hidden", "k"), ("burn_in_steps", "k"),
+               ("learning_steps", "k"), ("forward_steps", "k"))
+
+
+def slot_crc(views: dict, k: int, n_obs: int, n_steps: int) -> int:
+    """CRC32 of a block slot's used payload bytes (plus the shape header,
+    so a header/payload mismatch is also caught)."""
+    used = dict(k=k, n_obs=n_obs, n_steps=n_steps)
+    c = zlib.crc32(np.asarray([k, n_obs, n_steps], np.int64).tobytes())
+    for name, sel in _CRC_FIELDS:
+        c = zlib.crc32(views[name][:used[sel]].tobytes(), c)
+    c = zlib.crc32(views["priorities"].tobytes(), c)
+    return c & 0xFFFFFFFF
 
 
 def slot_layout(spec) -> Tuple[int, dict]:
@@ -189,6 +217,8 @@ def write_block(views: dict, block: Block, priorities: np.ndarray
     views["learning_steps"][:k] = block.learning_steps
     views["forward_steps"][:k] = block.forward_steps
     views["priorities"][:] = priorities
+    # CRC last: a slot is only valid once its integrity word matches
+    views["crc32"][0] = slot_crc(views, k, n_obs, n_steps)
     return k, n_obs, n_steps
 
 
@@ -354,6 +384,28 @@ class VectorLocalBuffer:
     def sizes(self) -> np.ndarray:
         """Per-lane current block sizes (read-only view)."""
         return self.size
+
+    # every array attribute, i.e. the buffer's whole mutable state — the
+    # actor snapshot payload (VectorActor.snapshot)
+    _STATE_FIELDS = ("obs", "last_action", "last_reward", "hidden",
+                     "action", "reward", "qval", "prefix", "size",
+                     "sum_reward")
+
+    def snapshot(self) -> dict:
+        """Copy of the full buffer state (all lanes) for the resumable
+        actor snapshot — in-progress blocks and carried burn-in prefixes
+        survive a preemption with it."""
+        return {k: getattr(self, k).copy() for k in self._STATE_FIELDS}
+
+    def load_snapshot(self, snap: dict) -> None:
+        """Restore state captured by :meth:`snapshot` (same geometry)."""
+        for k in self._STATE_FIELDS:
+            dst = getattr(self, k)
+            if dst.shape != snap[k].shape:
+                raise ValueError(
+                    f"local-buffer snapshot field {k!r} has shape "
+                    f"{snap[k].shape}, expected {dst.shape}")
+            dst[:] = snap[k]
 
     def reset_lane(self, i: int, init_obs: np.ndarray) -> None:
         self.obs[i, 0] = np.asarray(init_obs, np.uint8)

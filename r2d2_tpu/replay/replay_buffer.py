@@ -22,7 +22,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from r2d2_tpu.config import Config
-from r2d2_tpu.replay.block import Block
+from r2d2_tpu.replay.block import Block, slot_layout, slot_views
 from r2d2_tpu.replay.sum_tree import SumTree
 
 
@@ -79,6 +79,12 @@ def ring_bytes(cfg: Config, action_dim: int) -> int:
     of lazily-held ragged blocks."""
     return sum(int(np.prod(shape)) * np.dtype(dtype).itemsize
                for _, shape, dtype in _ring_spec(cfg, action_dim))
+
+
+def _layout_fingerprint(spec) -> list:
+    """JSON-able (name, shape, dtype) list identifying a snapshot layout."""
+    return [[name, list(shape), np.dtype(dtype).name]
+            for name, shape, dtype in spec]
 
 
 def _available_host_bytes() -> Optional[int]:
@@ -167,6 +173,7 @@ class ReplayBuffer:
         self.episode_reward = 0.0
         self.training_steps = 0
         self.sum_loss = 0.0
+        self.corrupt_blocks = 0  # wire-format CRC mismatches, never reset
 
     def __len__(self) -> int:
         return self.size
@@ -490,6 +497,13 @@ class ReplayBuffer:
             self.training_steps += 1
             self.sum_loss += float(loss)
 
+    def note_corrupt_block(self) -> None:
+        """A wire-format integrity check failed and the block was dropped
+        (actor_procs.ingest_once): count it so the log plane surfaces a
+        garbling transport instead of silently thinning the data."""
+        with self.lock:
+            self.corrupt_blocks += 1
+
     def note_updates(self, n: int, loss_sum: float) -> None:
         """Learner-side update accounting when priority feedback never
         crosses the host (``cfg.in_graph_per`` — the scatter happens
@@ -498,6 +512,96 @@ class ReplayBuffer:
         with self.lock:
             self.training_steps += n
             self.sum_loss += float(loss_sum)
+
+    # ------------------------------------------------------------- snapshot
+    # scalar state that rides the replay snapshot's JSON meta (arrays ride
+    # the binary payload); order is the wire order of the restore loop
+    STATE_COUNTERS = ("block_ptr", "size", "env_steps", "num_episodes",
+                      "episode_reward", "training_steps", "sum_loss",
+                      "corrupt_blocks")
+
+    def state_spec(self):
+        """(name, shape, dtype) of the on-disk replay-snapshot payload: the
+        ring arrays (the block.py slot layout reused at whole-ring scale)
+        plus the PER leaf vector."""
+        return _ring_spec(self.cfg, self.action_dim) + (
+            ("tree_leaves", (self.tree.capacity,), np.float64),)
+
+    def write_state(self, path: str) -> Dict[str, Any]:
+        """Serialise the full replay state into ``path`` — one flat binary
+        laid out by :func:`~r2d2_tpu.replay.block.slot_layout` over
+        :meth:`state_spec` (the shm wire format's own layout scheme, so the
+        on-disk format cannot drift from the ring a future field change
+        lands in).  Returns the JSON-able meta (counters + sampling RNG +
+        layout fingerprint) that :meth:`read_state` validates against.
+
+        Host-ring buffers only: a device ring's bulk arrays live in HBM
+        (and under ``in_graph_per`` so do the priorities) — those runs
+        save learner state alone (documented in docs/OPERATIONS.md)."""
+        if self.device_ring is not None:
+            raise RuntimeError(
+                "replay snapshot requires the host ring; device_replay "
+                "runs persist learner state only")
+        spec = self.state_spec()
+        nbytes, offsets = slot_layout(spec)
+        mm = np.memmap(path, np.uint8, "w+", shape=(nbytes,))
+        views = slot_views(mm, spec, offsets, nbytes, 0)
+        # the lock covers only the RAM-speed copy into the page cache (a
+        # consistent ring+tree+counter cut); the msync below — the
+        # disk-bound part, seconds at flagship ring sizes — runs with the
+        # lock RELEASED so periodic snapshots don't flatline actor ingest
+        # and batch staging for the duration of the write
+        with self.lock:
+            for name, _, _ in spec:
+                views[name][:] = (self.tree.leaf_values()
+                                  if name == "tree_leaves"
+                                  else getattr(self, name))
+            meta = dict(
+                layout=_layout_fingerprint(spec),
+                nbytes=nbytes,
+                counters={k: getattr(self, k) for k in self.STATE_COUNTERS},
+                rng_state=self.tree.rng.bit_generator.state,
+                tree_total=self.tree.total,
+            )
+        del views
+        mm.flush()
+        del mm
+        return meta
+
+    def read_state(self, path: str, meta: Dict[str, Any]) -> None:
+        """Restore the state :meth:`write_state` captured.  Raises
+        ``ValueError`` when the snapshot was written under a different
+        buffer geometry (the caller warns and resumes cold instead of
+        ingesting a misaligned ring)."""
+        spec = self.state_spec()
+        nbytes, offsets = slot_layout(spec)
+        want = _layout_fingerprint(spec)
+        if meta.get("layout") != want:
+            raise ValueError(
+                "replay snapshot layout mismatch — written under a "
+                "different buffer geometry/config; resuming with a cold "
+                f"buffer (snapshot {meta.get('layout')} vs config {want})")
+        mm = np.memmap(path, np.uint8, "r", shape=(nbytes,))
+        views = slot_views(mm, spec, offsets, nbytes, 0)
+        with self.lock:
+            for name, _, _ in spec:
+                if name == "tree_leaves":
+                    self.tree.load_leaves(views[name])
+                else:
+                    getattr(self, name)[:] = views[name]
+            c = meta["counters"]
+            self.block_ptr = int(c["block_ptr"])
+            self.size = int(c["size"])
+            self.env_steps = int(c["env_steps"])
+            self.num_episodes = int(c["num_episodes"])
+            self.episode_reward = float(c["episode_reward"])
+            self.training_steps = int(c["training_steps"])
+            self.sum_loss = float(c["sum_loss"])
+            self.corrupt_blocks = int(c.get("corrupt_blocks", 0))
+            if meta.get("rng_state") is not None:
+                self.tree.rng.bit_generator.state = meta["rng_state"]
+        del views
+        del mm
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> Dict[str, float]:
@@ -508,6 +612,7 @@ class ReplayBuffer:
                 num_episodes=self.num_episodes,
                 episode_reward=self.episode_reward,
                 sum_loss=self.sum_loss,
+                corrupt_blocks=self.corrupt_blocks,
             )
             self.episode_reward = 0.0
             self.num_episodes = 0

@@ -109,6 +109,34 @@ class SumTree:
         is_weights = (prios / min_p) ** (-self.is_exponent)
         return nodes - self.leaf_offset, is_weights
 
+    # ------------------------------------------------------------ snapshot
+    def leaf_values(self) -> np.ndarray:
+        """Raw leaf priorities (already ``td**alpha``), length ``capacity``
+        — the replay-snapshot payload (checkpoint.py save_replay)."""
+        return self.nodes[self.leaf_offset:self.leaf_offset
+                          + self.capacity].copy()
+
+    def load_leaves(self, leaves: np.ndarray) -> None:
+        """Restore raw leaf priorities (as returned by :meth:`leaf_values`)
+        and rebuild every ancestor bottom-up.
+
+        Bit-exact with the incrementally-maintained tree: :meth:`update`
+        keeps the invariant that every internal node is EXACTLY the float64
+        sum of its two children, so a whole-level bottom-up rebuild from
+        identical leaves reproduces the identical node array (asserted in
+        tests/test_recovery.py)."""
+        leaves = np.asarray(leaves, np.float64)
+        if leaves.shape != (self.capacity,):
+            raise ValueError(
+                f"leaf snapshot has shape {leaves.shape}, tree capacity is "
+                f"{self.capacity} — replay snapshot written under a "
+                "different buffer geometry")
+        self.nodes[:] = 0.0
+        self.nodes[self.leaf_offset:self.leaf_offset + self.capacity] = leaves
+        for level in range(self.num_levels - 2, -1, -1):
+            idx = np.arange(2 ** level - 1, 2 ** (level + 1) - 1)
+            self.nodes[idx] = self.nodes[2 * idx + 1] + self.nodes[2 * idx + 2]
+
     def prefix_mass(self, leaf_idx: int) -> float:
         """Total priority mass of all leaves strictly before ``leaf_idx``
         (O(log n) root walk)."""

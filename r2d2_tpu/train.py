@@ -35,7 +35,9 @@ fabric (replay, learner, supervision) is unchanged.
 """
 from __future__ import annotations
 
+import logging
 import queue
+import signal
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -54,8 +56,10 @@ from r2d2_tpu.parallel.mesh import make_mesh
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
 from r2d2_tpu.utils.math import epsilon_ladder
 from r2d2_tpu.utils.store import ParamStore
-from r2d2_tpu.utils.supervisor import Supervisor
+from r2d2_tpu.utils.supervisor import Heartbeat, Supervisor
 from r2d2_tpu.utils.trace import Tracer, device_profile
+
+log = logging.getLogger(__name__)
 
 EnvFactory = Callable[[Config, int], Any]
 
@@ -92,7 +96,8 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
     params = init_params(cfg, net, jax.random.PRNGKey(cfg.seed))
     state = create_train_state(cfg, params)
 
-    checkpointer = Checkpointer(checkpoint_dir) if checkpoint_dir else None
+    checkpointer = (Checkpointer(checkpoint_dir, keep=cfg.keep_checkpoints)
+                    if checkpoint_dir else None)
     start_env_steps, start_minutes = 0, 0.0
     if (checkpointer is not None and resume
             and checkpointer.latest_step() is not None):
@@ -243,10 +248,46 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                             cfg.seed + 7919 + 104729 * f))
             for f, (lo, hi) in enumerate(shards)
         ]
+    # full-state resume: a warm replay ring + resumable actor state saved
+    # by a previous run's drain-then-save exit (checkpoint.save_replay).
+    # Loaded AFTER everything is built so a failure here degrades to the
+    # plain learner-state resume above instead of killing bring-up.
+    restored_replay = False
+    if checkpointer is not None and resume:
+        rep = checkpointer.restore_replay()
+        if rep is not None and ring is None:
+            import warnings
+
+            meta_r, ring_path, actor_snaps = rep
+            try:
+                buffer.read_state(ring_path, meta_r)
+                restored_replay = True
+            except (ValueError, OSError) as e:
+                warnings.warn(f"replay snapshot not restored: {e}",
+                              stacklevel=2)
+            if restored_replay and actor_snaps:
+                if plane is not None:
+                    plane.set_restore_snapshots(actor_snaps)
+                else:
+                    for a, snap in zip(actors, actor_snaps):
+                        if snap is None:
+                            continue
+                        try:
+                            a.restore(snap)
+                        except ValueError as e:
+                            warnings.warn(f"actor snapshot skipped: {e}",
+                                          stacklevel=2)
+        elif rep is not None:
+            import warnings
+
+            warnings.warn(
+                "a replay snapshot exists but this run uses device_replay "
+                "— replay state lives in HBM and is not restored (resuming "
+                "with a cold ring)", stacklevel=2)
     return dict(cfg=cfg, envs=envs, action_dim=action_dim, net=net,
                 learner=learner, buffer=buffer, actors=actors,
                 actor=actors[0] if actors else None, plane=plane,
-                param_store=param_store,
+                param_store=param_store, restored_replay=restored_replay,
                 checkpointer=checkpointer, host_bs=host_bs, ring=ring)
 
 
@@ -345,15 +386,37 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     budget stops the run instead of hanging — SURVEY §5.3), a Tracer
     records per-stage timings and queue-depth gauges (SURVEY §5.1), and
     ``profile_dir`` captures a ``jax.profiler`` device trace of the run.
+
+    Preemption-safe: SIGTERM/SIGINT trigger a drain-then-save shutdown —
+    the learner checkpoints its final state and (``cfg.replay_snapshot``,
+    host-ring runs) the replay ring, sum-tree, counters and actor RNG/env
+    state are snapshotted atomically so ``resume=True`` restarts warm
+    (``cfg.replay_snapshot_interval`` adds periodic mid-run snapshots
+    against kill -9).  ``cfg.learner_stall_timeout`` arms a heartbeat
+    watchdog that stops the fabric when the learner thread freezes, and
+    ``cfg.chaos_spec`` (utils/chaos.py) injects deterministic faults for
+    recovery drills.
     """
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     cfg = sys["cfg"]  # the EFFECTIVE config (degrade paths flip flags)
     actors: List[VectorActor] = sys["actors"]
     buffer: ReplayBuffer = sys["buffer"]
     learner: Learner = sys["learner"]
+    checkpointer = sys["checkpointer"]
     plane = sys["plane"]
     tracer = tracer or Tracer()
     supervisor = Supervisor(max_restarts=max_thread_restarts)
+
+    chaos = None
+    if cfg.chaos_spec:
+        from r2d2_tpu.utils.chaos import ChaosInjector
+
+        chaos = ChaosInjector(cfg.chaos_spec, seed=cfg.seed)
+        if checkpointer is not None:
+            checkpointer.chaos = chaos
+    if plane is not None:
+        # CRC-failed blocks dropped at ingest surface in buffer.stats()
+        plane.on_corrupt = buffer.note_corrupt_block
 
     stop_event = threading.Event()
     deadline = (time.time() + max_wall_seconds) if max_wall_seconds else None
@@ -361,6 +424,46 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     def stop() -> bool:
         return (stop_event.is_set() or supervisor.any_failed
                 or (deadline is not None and time.time() > deadline))
+
+    # preemption hook: SIGTERM/SIGINT request a drain-then-save shutdown —
+    # the learner exits at its next stop poll, the fabric quiesces, and
+    # the epilogue below writes the full-state snapshot (learner state via
+    # Learner.run's own final save; replay ring + actor state via
+    # checkpointer.save_replay).  Signals only reach the main thread;
+    # a train() driven from a worker thread (tests, sweep) skips the hook.
+    prev_handlers: Dict[int, Any] = {}
+    if threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            log.warning("signal %d: draining fabric, then saving full "
+                        "state", signum)
+            stop_event.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(sig, _on_signal)
+            except (ValueError, OSError):  # exotic embedding: no signals
+                pass
+
+    # full-state snapshots need the host ring (device_replay state lives
+    # in HBM) and a single process (per-host snapshot dirs would collide)
+    want_full_save = (checkpointer is not None and cfg.replay_snapshot
+                      and sys["ring"] is None and jax.process_count() == 1)
+
+    # learner liveness: the learner beats through every stop poll (loop
+    # iterations AND queue waits), so a stale heartbeat means a genuinely
+    # frozen thread — wedged collective, dead interconnect, chaos freeze —
+    # not a slow batch.  The watchdog stops the fabric instead of letting
+    # actors feed a wedged learner forever.
+    heartbeat = Heartbeat()
+    stall = {"stalled": False}
+
+    def learner_stop() -> bool:
+        if chaos is not None:
+            freeze = chaos.learner_freeze_seconds()
+            if freeze > 0:
+                time.sleep(freeze)
+        heartbeat.beat()
+        return stop()
 
     batch_queue: "queue.Queue" = queue.Queue(maxsize=8)
     priority_queue: "queue.Queue" = queue.Queue(maxsize=8)
@@ -419,6 +522,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 mean_loss=(s["sum_loss"] / max(1, s["training_steps"] - last_steps)),
                 trace=tracer.snapshot(),
                 health=supervisor.health(),
+                learner_heartbeat_age=heartbeat.age(),
             )
             if plane is not None:
                 entry["fleet"] = plane.health()
@@ -434,8 +538,50 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                       f"loss={entry['mean_loss']:.4f}", flush=True)
             last_steps, last_time = s["training_steps"], now
 
+    def learner_watch():
+        poll = min(0.05, cfg.learner_stall_timeout / 4)
+        while not stop():
+            time.sleep(poll)
+            if heartbeat.age() > cfg.learner_stall_timeout:
+                stall["stalled"] = True
+                log.error("learner heartbeat stale for %.1fs (budget "
+                          "%.1fs): declaring a stall and stopping the "
+                          "fabric", heartbeat.age(),
+                          cfg.learner_stall_timeout)
+                stop_event.set()
+                return
+
+    def chaos_loop():
+        # process-plane fault sites (fleet kill, slab garbling); learner
+        # freeze fires from learner_stop, checkpoint truncation from the
+        # Checkpointer itself
+        while not stop():
+            time.sleep(0.05)
+            chaos.maybe_kill_fleet(plane)
+            chaos.maybe_garble_block(plane)
+
+    def snapshot_loop():
+        # periodic insurance against kill -9 (no drain possible): the
+        # buffer snapshot is lock-consistent; thread-transport actor state
+        # is only captured by the quiesced shutdown save
+        last = time.time()
+        while not stop():
+            time.sleep(0.2)
+            if time.time() - last < cfg.replay_snapshot_interval:
+                continue
+            sys["checkpointer"].save_replay(buffer.training_steps,
+                                            buffer.write_state)
+            last = time.time()
+
     loops = [(f"actor{f}" if len(actors) > 1 else "actor",
               make_actor_loop(a)) for f, a in enumerate(actors)]
+    if cfg.learner_stall_timeout > 0:
+        loops.append(("learner_watch", learner_watch))
+    if chaos is not None and plane is not None and (
+            chaos.enabled("kill_fleet") or chaos.enabled("garble_block")):
+        loops.append(("chaos", chaos_loop))
+    if want_full_save and cfg.replay_snapshot_interval > 0:
+        loops.append(("snapshot", snapshot_loop))
     if plane is not None:
         # process transport: fleets are subprocesses; their trainer-side
         # plumbing (block ingest, weight pump, process watchdog) runs as
@@ -452,8 +598,12 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         # scatters it on-device) — nothing would ever feed this queue
         loops = [(n, f) for n, f in loops if n != "priority"]
 
+    # both run on the learner thread, so their waits poll learner_stop:
+    # the heartbeat keeps beating through a legitimately slow batch (the
+    # watchdog only fires on a FROZEN thread), and a chaos freeze bites
+    # wherever the learner happens to be waiting
     def batch_source():
-        while not stop():
+        while not learner_stop():
             try:
                 return batch_queue.get(timeout=0.1)
             except queue.Empty:
@@ -461,7 +611,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         return None
 
     def priority_sink(idxes, priorities, old_ptr, loss):
-        while not stop():
+        while not learner_stop():
             try:
                 priority_queue.put((idxes, priorities, old_ptr, loss),
                                    timeout=0.1)
@@ -478,41 +628,78 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     # fabric threads) lives INSIDE the try: a failure anywhere in bring-up
     # must still reach the teardown below, or a caller catching the
     # exception is left with orphaned processes and /dev/shm slabs
+    # handlers stay installed through the post-drain full-state save:
+    # a second SIGTERM during the drain/snapshot must keep requesting a
+    # clean stop, not kill the process mid-write (the save is atomic
+    # either way, but the snapshot would be lost); restored on EVERY
+    # exit path, including exceptions
     try:
-        if plane is not None:
-            plane.start(sys["param_store"])
-        for name, loop in loops:
-            supervisor.start(name, loop)
-        with device_profile(profile_dir):
-            if sys["ring"] is not None:
-                metrics = learner.run_device(buffer, sys["ring"],
-                                             priority_sink, stop=stop,
-                                             tracer=tracer)
-            else:
-                metrics = learner.run(batch_source, priority_sink, stop=stop,
-                                      tracer=tracer)
-    finally:
-        stop_event.set()
-        supervisor.join_all(timeout=5.0)
-        for a in actors:
-            a.close()
-        if plane is not None:
-            plane.shutdown()
-
-    # drain remaining priority feedback so buffer counters are final
-    while True:
+        fleet_snaps = None
         try:
-            idxes, priorities, old_ptr, loss = priority_queue.get_nowait()
-        except queue.Empty:
-            break
-        buffer.update_priorities(idxes, priorities, old_ptr, loss)
+            if plane is not None:
+                plane.start(sys["param_store"])
+            for name, loop in loops:
+                supervisor.start(name, loop)
+            with device_profile(profile_dir):
+                if sys["ring"] is not None:
+                    metrics = learner.run_device(buffer, sys["ring"],
+                                                 priority_sink,
+                                                 stop=learner_stop,
+                                                 tracer=tracer)
+                else:
+                    metrics = learner.run(batch_source, priority_sink,
+                                          stop=learner_stop, tracer=tracer)
+        finally:
+            stop_event.set()
+            supervisor.join_all(timeout=5.0)
+            if plane is not None:
+                # drain-then-save: collect resumable actor snapshots from the
+                # dying fleets (answered by their shutdown handshake)
+                fleet_snaps = plane.shutdown(snapshot=want_full_save)
+            for a in actors:
+                a.close()
 
-    metrics.update(buffer_size=len(buffer), logs=logs,
-                   buffer_training_steps=buffer.training_steps,
-                   final_params=learner.state.params,
-                   trace=tracer.snapshot(), health=supervisor.health(),
-                   fabric_failed=(supervisor.any_failed
-                                  or (plane is not None and plane.failed)))
-    if plane is not None:
-        metrics["fleet_health"] = plane.health()
-    return metrics
+        # drain remaining priority feedback so buffer counters are final
+        while True:
+            try:
+                idxes, priorities, old_ptr, loss = priority_queue.get_nowait()
+            except queue.Empty:
+                break
+            buffer.update_priorities(idxes, priorities, old_ptr, loss)
+
+        # full-state snapshot, AFTER the drain so ring priorities/counters are
+        # final: the learner state was already saved by Learner.run's epilogue;
+        # this persists the warm replay ring + sum-tree + actor RNG/env state
+        # next to it, atomically — what --resume restores through _build
+        if want_full_save:
+            try:
+                actor_snaps = (fleet_snaps if plane is not None
+                               else [a.snapshot() for a in actors])
+                try:
+                    step = learner.num_updates
+                except Exception:  # learner died mid-dispatch: tag host-side
+                    step = buffer.training_steps
+                checkpointer.save_replay(step, buffer.write_state,
+                                         actors=actor_snaps)
+            except Exception as e:  # never fail the run over snapshot I/O
+                log.warning("full-state replay snapshot failed: %s", e)
+
+        metrics.update(buffer_size=len(buffer), logs=logs,
+                       buffer_training_steps=buffer.training_steps,
+                       final_params=learner.state.params,
+                       restored_replay=sys["restored_replay"],
+                       learner_stalled=stall["stalled"],
+                       trace=tracer.snapshot(), health=supervisor.health(),
+                       fabric_failed=(supervisor.any_failed
+                                      or (plane is not None and plane.failed)))
+        if chaos is not None:
+            metrics["chaos"] = chaos.counts()
+        if plane is not None:
+            metrics["fleet_health"] = plane.health()
+        return metrics
+    finally:
+        for sig, handler in prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass

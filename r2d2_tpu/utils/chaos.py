@@ -1,0 +1,170 @@
+"""Deterministic fault injection for the training fabric.
+
+Podracer-style systems treat preemption as routine; the only way the
+recovery paths stay honest is to force the failures on purpose.  A
+:class:`ChaosInjector` is built from ``cfg.chaos_spec`` (empty string =
+disabled, the production default) and wired through ``train()`` so every
+recovery path the fabric claims to have can be exercised under load:
+
+- ``kill_fleet``    — SIGKILL a random live fleet subprocess (the process
+                      watchdog must respawn it on its lane shard).
+- ``garble_block``  — flip bytes inside a random shm block slot (the CRC32
+                      integrity word must catch it; the trainer drops the
+                      block and bumps ``ReplayBuffer.stats()['corrupt_blocks']``).
+- ``truncate_ckpt`` — abort a checkpoint save mid-write (payload truncated
+                      / replay meta never committed; restore must skip the
+                      partial step).
+- ``freeze_learner``— sleep inside the learner's stop-poll for ``dur``
+                      seconds (the heartbeat watchdog must detect the
+                      stall and stop the fabric).
+
+Spec grammar — semicolon-separated ``kind[:key=val[,key=val...]]``::
+
+    kill_fleet:every=500;garble_block:p=0.01;freeze_learner:at=40,dur=3
+
+Per-kind firing controls (an *opportunity* is one call site visit):
+
+- ``p=<float>``   fire with probability p per opportunity (seeded draw)
+- ``every=<int>`` fire on every Nth opportunity
+- ``at=<int>``    fire exactly once, on the Nth opportunity
+- ``n=<int>``     cap total fires (default: 1 for ``at``, unlimited else)
+- ``dur=<float>`` freeze duration in seconds (``freeze_learner`` only)
+
+Everything is deterministic given (spec, seed): each kind gets its own
+counter and a PCG64 stream seeded from (seed, kind), so a chaos soak is
+replayable.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_KINDS = ("kill_fleet", "garble_block", "truncate_ckpt", "freeze_learner")
+
+
+def parse_spec(spec: str) -> Dict[str, Dict[str, float]]:
+    """``chaos_spec`` string → {kind: params}.  Raises ValueError on an
+    unknown kind or a malformed clause (Config validation calls this so a
+    typo fails at construction, not mid-run)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for clause in filter(None, (c.strip() for c in spec.split(";"))):
+        kind, _, raw = clause.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown chaos kind {kind!r} (expected one of {_KINDS})")
+        params: Dict[str, float] = {}
+        for kv in filter(None, (p.strip() for p in raw.split(","))):
+            key, _, val = kv.partition("=")
+            if key not in ("p", "every", "at", "n", "dur"):
+                raise ValueError(f"unknown chaos param {key!r} in {clause!r}")
+            params[key] = float(val)
+        if not any(k in params for k in ("p", "every", "at")):
+            raise ValueError(
+                f"chaos clause {clause!r} needs a trigger (p=/every=/at=)")
+        out[kind] = params
+    return out
+
+
+class ChaosInjector:
+    """Seeded, counter-deterministic fault firing (see module docstring).
+    Thread-safe: call sites live on different fabric threads."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.kinds = parse_spec(spec)
+        self._lock = threading.Lock()
+        self._opportunities = {k: 0 for k in self.kinds}
+        self._fires = {k: 0 for k in self.kinds}
+        self._rngs = {
+            k: np.random.default_rng([seed, i])
+            for i, k in enumerate(_KINDS) if k in self.kinds
+        }
+
+    def __bool__(self) -> bool:
+        return bool(self.kinds)
+
+    def enabled(self, kind: str) -> bool:
+        return kind in self.kinds
+
+    def fire(self, kind: str) -> Optional[Dict[str, float]]:
+        """One opportunity for ``kind``: returns the clause params when the
+        fault fires, else None."""
+        prm = self.kinds.get(kind)
+        if prm is None:
+            return None
+        with self._lock:
+            self._opportunities[kind] += 1
+            opp = self._opportunities[kind]
+            cap = prm.get("n", 1.0 if "at" in prm else math.inf)
+            if self._fires[kind] >= cap:
+                return None
+            if "at" in prm:
+                hit = opp == int(prm["at"])
+            elif "every" in prm:
+                hit = opp % max(1, int(prm["every"])) == 0
+            else:
+                hit = float(self._rngs[kind].random()) < prm["p"]
+            if not hit:
+                return None
+            self._fires[kind] += 1
+        log.warning("chaos: firing %s (opportunity %d)", kind, opp)
+        return prm
+
+    def counts(self) -> Dict[str, int]:
+        """Fires per kind so far — surfaced in train() metrics/logs."""
+        with self._lock:
+            return dict(self._fires)
+
+    # ---------------------------------------------------------- call sites
+    def maybe_kill_fleet(self, plane: Any) -> Optional[int]:
+        """SIGKILL a random live fleet process of a ProcessFleetPlane.
+        Returns the killed fleet id, or None."""
+        if self.fire("kill_fleet") is None:
+            return None
+        live = [f for f, p in enumerate(plane.procs)
+                if p is not None and p.is_alive()]
+        if not live:
+            return None
+        f = int(live[self._rngs["kill_fleet"].integers(len(live))])
+        log.warning("chaos: SIGKILL fleet%d (pid %s)", f, plane.procs[f].pid)
+        plane.procs[f].kill()
+        return f
+
+    def maybe_garble_block(self, plane: Any) -> Optional[int]:
+        """Flip 64 bytes at a random offset inside a random slot of a
+        random fleet's shm slab.  An in-flight block whose CRC was already
+        written shows up as a mismatch at ingest (dropped + counted); a
+        free slot is harmlessly overwritten by the next producer write.
+        Returns the garbled fleet id, or None."""
+        if self.fire("garble_block") is None:
+            return None
+        rng = self._rngs["garble_block"]
+        # capture (fleet, channel) together: the fleet watchdog may retire
+        # a channel concurrently, and .index() on a retired object would
+        # crash the chaos loop mid-drill
+        chans = [(f, c) for f, c in enumerate(plane.channels)
+                 if c is not None]
+        if not chans:
+            return None
+        f, ch = chans[int(rng.integers(len(chans)))]
+        slot = int(rng.integers(ch.num_slots))
+        lo = slot * ch.slot_nbytes + int(rng.integers(
+            max(1, ch.slot_nbytes - 64)))
+        try:
+            buf = np.frombuffer(ch.shm.buf, np.uint8)
+            buf[lo:lo + 64] ^= 0xFF
+        except (ValueError, TypeError):  # channel closed under us
+            return None
+        return f
+
+    def learner_freeze_seconds(self) -> float:
+        """Seconds the learner's stop-poll should sleep this iteration
+        (0.0 = no freeze injected)."""
+        prm = self.fire("freeze_learner")
+        return float(prm.get("dur", 2.0)) if prm else 0.0

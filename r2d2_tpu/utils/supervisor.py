@@ -99,6 +99,23 @@ class SupervisedThread:
             self._thread.join(timeout)
 
 
+class Heartbeat:
+    """Liveness pulse for a loop that thread-alive checks can't supervise
+    (the learner runs on the caller's own thread): the loop calls
+    :meth:`beat` every iteration; a watchdog reads :meth:`age` and treats
+    a large value as a stall — frozen thread, wedged collective, dead
+    interconnect.  Plain float assignment is GIL-atomic, so no lock."""
+
+    def __init__(self):
+        self._last = time.time()
+
+    def beat(self) -> None:
+        self._last = time.time()
+
+    def age(self) -> float:
+        return time.time() - self._last
+
+
 class Supervisor:
     """Supervises the fabric's worker threads.
 
@@ -115,6 +132,13 @@ class Supervisor:
         self._failed = threading.Event()
 
     def start(self, name: str, loop: Callable[[], None]) -> SupervisedThread:
+        if name in self.threads:
+            # silent replacement would orphan the old SupervisedThread —
+            # its live loop and any pending backoff timer keep running
+            # OUTSIDE supervision (unjoinable, uncancellable at shutdown)
+            raise ValueError(
+                f"thread {name!r} is already supervised; stop() it first "
+                "or pick a distinct name")
         t = SupervisedThread(name, loop, self.max_restarts, self.backoff,
                              on_giveup=lambda _n: self._failed.set())
         self.threads[name] = t

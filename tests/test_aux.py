@@ -202,3 +202,84 @@ def test_compile_cache_enable_and_disable(tmp_path, monkeypatch):
         jax.config.update("jax_compilation_cache_dir", prev_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           prev_min)
+
+
+# --- supervised-thread lifecycle races (ISSUE 2 satellites) ---------------
+
+def test_supervised_thread_stop_cancels_pending_backoff_timer():
+    """stop() during the backoff window must cancel the pending restart
+    timer: the loop may never run again — the stop()-vs-timer race, only
+    indirectly exercised via join_all before."""
+    from r2d2_tpu.utils.supervisor import SupervisedThread
+
+    runs = []
+
+    def loop():
+        runs.append(1)
+        raise RuntimeError("crash")
+
+    t = SupervisedThread("racy", loop, max_restarts=5, backoff=0.3)
+    t.start()
+    deadline = time.time() + 5.0
+    while not runs and time.time() < deadline:
+        time.sleep(0.005)
+    t.join(2.0)           # first incarnation dead, 0.3s timer pending
+    assert runs == [1]
+    t.stop()              # must cancel the timer
+    assert t._pending_timer is None
+    time.sleep(0.6)       # well past the backoff
+    assert runs == [1], "a cancelled backoff timer still restarted the loop"
+    assert not t.alive
+
+
+def test_supervised_thread_stop_beats_fired_timer():
+    """The other side of the race: the timer FIRES first, then stop()
+    lands before the new thread launches — start() must observe _stopping
+    and refuse to resurrect the loop."""
+    from r2d2_tpu.utils.supervisor import SupervisedThread
+
+    t = SupervisedThread("racy2", lambda: None, max_restarts=5, backoff=0.1)
+    t.stop()
+    t.start()             # the fired timer calls start() post-stop
+    assert t._thread is None and not t.alive
+
+
+def test_supervised_thread_restart_counting_across_multiple_crashes():
+    """Every induced crash must be counted and recorded exactly once, and
+    the thread must keep recovering while budget remains."""
+    from r2d2_tpu.utils.supervisor import SupervisedThread
+
+    crashes = 3
+    runs = []
+    done = threading.Event()
+
+    def loop():
+        runs.append(1)
+        if len(runs) <= crashes:
+            raise RuntimeError(f"induced crash {len(runs)}")
+        done.set()
+
+    t = SupervisedThread("crashy", loop, max_restarts=5, backoff=0.01)
+    t.start()
+    assert done.wait(10.0), "thread never recovered through its crashes"
+    assert t.restarts == crashes
+    assert len(t.errors) == crashes
+    assert [e["message"] for e in t.errors] == [
+        f"induced crash {i}" for i in range(1, crashes + 1)]
+    assert not t.gave_up
+
+
+def test_supervisor_start_duplicate_name_raises():
+    """Silently overwriting self.threads[name] would orphan the old
+    SupervisedThread (and its pending backoff timer) outside supervision
+    — start() must refuse instead."""
+    stop = threading.Event()
+    sup = Supervisor()
+    sup.start("worker", lambda: stop.wait(5.0))
+    try:
+        with pytest.raises(ValueError, match="already supervised"):
+            sup.start("worker", lambda: None)
+        assert sup.threads["worker"].alive  # original untouched
+    finally:
+        stop.set()
+        sup.join_all(timeout=2.0)

@@ -1,0 +1,243 @@
+"""Fault-injection drills (ISSUE 2 tentpole, ``chaos`` marker — tier-1).
+
+Every chaos failure mode has a test asserting the SPECIFIC recovery
+behavior: a garbled shm block is dropped and counted, a truncated
+checkpoint is never selected for restore, a frozen learner trips the
+heartbeat watchdog, and a killed fleet process is respawned on its lane
+shard.  The injector itself is deterministic given (spec, seed) so soaks
+replay.
+"""
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.checkpoint import Checkpointer
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.utils.chaos import ChaosInjector, parse_spec
+
+A = 4
+
+pytestmark = pytest.mark.chaos
+
+
+def env_factory(cfg, seed):
+    return FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=seed,
+                        episode_len=32)
+
+
+# ------------------------------------------------------------ the injector
+
+def test_spec_parse_and_config_validation():
+    spec = parse_spec("kill_fleet:every=100;garble_block:p=0.5;"
+                      "freeze_learner:at=3,dur=2.5")
+    assert spec["kill_fleet"] == {"every": 100.0}
+    assert spec["freeze_learner"] == {"at": 3.0, "dur": 2.5}
+    assert parse_spec("") == {}
+    with pytest.raises(ValueError, match="unknown chaos kind"):
+        parse_spec("explode:p=1")
+    with pytest.raises(ValueError, match="trigger"):
+        parse_spec("kill_fleet:dur=2")
+    with pytest.raises(ValueError, match="unknown chaos param"):
+        parse_spec("kill_fleet:rate=2")
+    # a typo'd cfg.chaos_spec fails at Config construction, not mid-run
+    with pytest.raises(ValueError, match="unknown chaos kind"):
+        make_test_config(chaos_spec="explode:p=1")
+    assert make_test_config(chaos_spec="kill_fleet:at=5").chaos_spec
+
+
+def test_injector_is_deterministic_and_counted():
+    fires = []
+    for _ in range(2):
+        inj = ChaosInjector("garble_block:p=0.3;freeze_learner:at=4",
+                            seed=7)
+        fires.append([bool(inj.fire("garble_block")) for _ in range(50)])
+        # at=4 fires exactly once, on the 4th opportunity
+        hits = [bool(inj.fire("freeze_learner")) for _ in range(10)]
+        assert hits == [False] * 3 + [True] + [False] * 6
+    assert fires[0] == fires[1], "same (spec, seed) must replay identically"
+    assert any(fires[0]) and not all(fires[0])
+    inj2 = ChaosInjector("kill_fleet:every=3", seed=0)
+    hits = [bool(inj2.fire("kill_fleet")) for _ in range(9)]
+    assert hits == [False, False, True] * 3
+    assert inj2.counts() == {"kill_fleet": 3}
+    assert inj2.fire("garble_block") is None  # not in the spec
+
+
+# ----------------------------------------------------------- garbled block
+
+def test_garbled_block_dropped_and_counted():
+    """Chaos garbles a shm slot: the CRC32 integrity word must catch it at
+    ingest — the block is dropped (never reaches the ring), the corrupt
+    counter surfaces in ReplayBuffer.stats(), and later blocks flow."""
+    from r2d2_tpu.parallel.actor_procs import (
+        ProcessFleetPlane,
+        ShmBlockChannel,
+        ShmBlockProducer,
+    )
+    from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+    from test_actor_procs import scripted_blocks
+
+    cfg = make_test_config(num_actors=1, actor_transport="process")
+    ctx = mp.get_context("spawn")
+    plane = ProcessFleetPlane(cfg, A, env_factory, [0.4])
+    channel = ShmBlockChannel(cfg, A, num_slots=4, ctx=ctx)
+    plane.channels[0] = channel  # in-process producer: no subprocess spawn
+    producer = ShmBlockProducer(cfg, A, channel.producer_info(), ctx.Event())
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1))
+    plane.on_corrupt = buf.note_corrupt_block
+
+    items = scripted_blocks(cfg, 2)
+    try:
+        for blk, prios, ep in items:
+            producer.send(blk, prios, ep)
+        # chaos site: garble the first in-flight slot's payload
+        inj = ChaosInjector("garble_block:at=1", seed=3)
+        assert inj.maybe_garble_block(plane) == 0
+        # the injector picks a random slot; pin the damage onto slot 0 too
+        # so the first ready block is guaranteed torn
+        off = 0 * channel.slot_nbytes + channel.offsets["obs"] + 3
+        np.frombuffer(channel.shm.buf, np.uint8)[off:off + 64] ^= 0xFF
+
+        sunk = []
+        for _ in range(4):
+            plane.ingest_once(lambda b, p, e: sunk.append(b), timeout=0)
+        assert plane.blocks_corrupt >= 1
+        assert buf.stats()["corrupt_blocks"] == plane.blocks_corrupt
+        # the clean block(s) still made it through intact
+        assert len(sunk) == 2 - plane.blocks_corrupt
+        assert plane.health()["blocks_corrupt"] == plane.blocks_corrupt
+    finally:
+        producer.close()
+        channel.close()
+
+
+# ------------------------------------------------------ truncated checkpoint
+
+def test_truncated_checkpoint_never_selected(tmp_path):
+    """Chaos truncates a save mid-write (payload chopped, sidecar never
+    written): restore must keep using the last complete step."""
+    ck = Checkpointer(str(tmp_path))
+    ck.chaos = ChaosInjector("truncate_ckpt:at=2", seed=0)
+    state = {"w": np.arange(8.0)}
+    ck.save(1, state, meta={"env_steps": 11})
+    ck.save(2, {"w": np.full(8, 9.0)}, meta={"env_steps": 22})  # truncated
+
+    assert ck.steps() == [1]
+    assert ck.steps(complete=False) == [1, 2]  # the partial dir exists...
+    assert ck.latest_step() == 1               # ...but is never selected
+    restored, meta = ck.restore({"w": np.zeros(8)})
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    assert meta["env_steps"] == 11
+
+
+def test_truncated_replay_snapshot_never_selected(tmp_path):
+    """Chaos aborts a replay snapshot before its meta.json commit: the
+    partial tmp dir is invisible to restore_replay."""
+    from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+    from test_recovery import fill_buffer
+
+    cfg = make_test_config()
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1))
+    fill_buffer(cfg, buf, 4)
+    ck = Checkpointer(str(tmp_path))
+    ck.save_replay(3, buf.write_state)
+    ck.chaos = ChaosInjector("truncate_ckpt:at=1", seed=0)
+    ck.save_replay(8, buf.write_state)  # aborted mid-write
+
+    assert ck.replay_steps() == [3]
+    meta, ring_path, _ = ck.restore_replay()
+    assert meta["step"] == 3
+    buf2 = ReplayBuffer(cfg, A, rng=np.random.default_rng(2))
+    buf2.read_state(ring_path, meta)
+    assert buf2.tree.total == buf.tree.total
+
+
+# ------------------------------------------------------------ learner stall
+
+def test_learner_freeze_detected_by_heartbeat_watchdog():
+    """Chaos freezes the learner thread mid-run: the heartbeat watchdog
+    must declare the stall within its budget and stop the fabric instead
+    of letting the actors feed a wedged learner forever."""
+    cfg = make_test_config(game_name="Fake", training_steps=500,
+                           log_interval=0.2,
+                           chaos_spec="freeze_learner:at=3,dur=1.5",
+                           learner_stall_timeout=0.4)
+    t0 = time.time()
+    from r2d2_tpu.train import train
+
+    m = train(cfg, env_factory=env_factory, verbose=False,
+              max_wall_seconds=120)
+    assert m["learner_stalled"], "watchdog never saw the freeze"
+    assert m["chaos"]["freeze_learner"] == 1
+    assert m["num_updates"] < 500  # the run was cut short by the stall
+    assert time.time() - t0 < 60
+
+
+def test_healthy_run_with_watchdog_does_not_false_alarm():
+    """The heartbeat beats through queue waits and slow batches, so an
+    armed watchdog must not trip on a healthy run."""
+    from r2d2_tpu.train import train
+
+    cfg = make_test_config(game_name="Fake", training_steps=10,
+                           log_interval=0.2, learner_stall_timeout=30.0)
+    m = train(cfg, env_factory=env_factory, verbose=False,
+              max_wall_seconds=120)
+    assert not m["learner_stalled"]
+    assert m["num_updates"] >= 10
+
+
+# ---------------------------------------------------------------- fleet kill
+
+@pytest.mark.timeout(600)
+def test_chaos_kill_fleet_respawned_on_shard():
+    """Chaos SIGKILLs a fleet subprocess: the process watchdog must
+    respawn it on the same lane shard (fresh channel, blocks flowing
+    again) — the recovery path PR 1 added, now provable under injected
+    faults.  Kept tier-1 per the chaos-marker policy: one fleet, two
+    spawns."""
+    import jax
+
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane
+    from r2d2_tpu.utils.store import ParamStore
+    from test_actor_procs import make_fake_env
+
+    cfg = make_test_config(game_name="Fake", num_actors=1, actor_fleets=1,
+                           actor_transport="process")
+    net = create_network(cfg, A)
+    store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4], max_restarts=2)
+    inj = ChaosInjector("kill_fleet:at=1", seed=0)
+    got = []
+
+    def drain(n, budget):
+        deadline = time.time() + budget
+        while len(got) < n and time.time() < deadline:
+            plane.ingest_once(lambda b, p, e: got.append(1), timeout=0.2)
+        return len(got) >= n
+
+    try:
+        plane.start(store)
+        assert drain(2, 120), "no blocks before the injected kill"
+        victim = plane.procs[0]
+        old_channel = plane.channels[0]
+        assert inj.maybe_kill_fleet(plane) == 0
+        victim.join(15)
+        assert not victim.is_alive()
+
+        deadline = time.time() + 30
+        while plane.watch_once() == 0:
+            assert time.time() < deadline, "watchdog never saw the death"
+            time.sleep(0.1)
+        assert plane.restarts[0] == 1 and not plane.failed
+        assert plane.procs[0] is not victim and plane.procs[0].is_alive()
+        assert plane.channels[0] is not old_channel  # channel retired
+
+        n0 = len(got)
+        assert drain(n0 + 2, 120), "no blocks after the chaos respawn"
+    finally:
+        plane.shutdown()
+    assert all(p is None or not p.is_alive() for p in plane.procs)

