@@ -173,6 +173,15 @@ class VectorActor:
         self.envs = list(envs)
         self.epsilons = np.asarray(epsilons, np.float64)
         self.act_fn = act_fn
+        # serve mode (parallel/inference_service.RemoteActClient, duck-
+        # typed to avoid the import cycle): acting is an RPC to the
+        # trainer's InferenceService — params and recurrent state live
+        # server-side, and lane resets must reach the server so it can
+        # zero that lane's hidden.  ``peek`` (when the act fn offers it)
+        # is the no-state-advance bootstrap forward the episode-step cap
+        # needs; local act fns are pure, so the plain call doubles as it.
+        self._act_client = act_fn if hasattr(act_fn, "note_reset") else None
+        self._peek_fn = getattr(act_fn, "peek", act_fn)
         self.param_store = param_store
         self.sink = sink
         self.rng = rng or np.random.default_rng(cfg.seed)
@@ -222,8 +231,12 @@ class VectorActor:
         self.vbuf.reset_lane(i, self.obs[i])
         self.episode_steps[i] = 0
         self.finish_pending[i] = False
+        if self._act_client is not None:
+            self._act_client.note_reset(i)
 
     def _refresh_params(self) -> None:
+        if self._act_client is not None:
+            return  # serve mode: weights never leave the trainer
         if self._act_device is not None:
             # actor inference runs on the CPU backend: the reference's
             # actors hold CPU model copies (worker.py:504-507), and on an
@@ -289,6 +302,11 @@ class VectorActor:
             raise ValueError(
                 f"actor snapshot has {snap['num_lanes']} lanes, this actor "
                 f"has {self.N} — resuming cold")
+        if self._act_client is not None:
+            # lanes resuming mid-episode must not request a server-side
+            # hidden zero — the restored server state is authoritative;
+            # non-resumable lanes re-note themselves via _reset_lane below
+            self._act_client.clear_reset_notes()
         self.rng.bit_generator.state = snap["rng"]
         self.actor_steps = int(snap["actor_steps"])
         self.episode_steps[:] = snap["episode_steps"]
@@ -335,7 +353,8 @@ class VectorActor:
         """Run ``max_steps`` lockstep iterations (= per-actor env steps)."""
         cfg = self.cfg
         self._refresh_params()
-        assert self._params is not None, "ParamStore must hold initial params"
+        assert self._params is not None or self._act_client is not None, \
+            "ParamStore must hold initial params"
 
         for _ in range(max_steps):
             if stop is not None and stop():
@@ -405,10 +424,11 @@ class VectorActor:
             if capped.size:
                 # episode-step cap (rare): the bootstrap must be Q at the
                 # post-step state (worker.py:550-554 runs a second forward);
-                # one extra batched forward covers all capped lanes
-                q_fresh, _ = self.act_fn(self._params, self.obs,
-                                         self.last_action, self.last_reward,
-                                         self.hidden)
+                # one extra batched forward covers all capped lanes; the
+                # peek variant (serve mode) must not advance server state
+                q_fresh, _ = self._peek_fn(self._params, self.obs,
+                                           self.last_action,
+                                           self.last_reward, self.hidden)
                 q_fresh = np.asarray(q_fresh)
                 for i in capped:
                     item = self.vbuf.finish(i, q_fresh[i])

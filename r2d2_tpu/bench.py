@@ -197,12 +197,16 @@ def _bench_env_factory(cfg, seed):
 
 def _actor_plane_bench_process(num_lanes: int = 64, fleets: int = 2,
                                env_workers: int = 0,
-                               budget_s: float = 300.0):
+                               budget_s: float = 300.0,
+                               actor_inference: str = "local"):
     """env-frames/s of the PROCESS-fleet actor plane on fake envs — the
     same pong-scale workload as :func:`_actor_plane_bench`, through
     ``parallel/actor_procs`` instead of in-process threads, so
     tools/actor_scaling.py can put the thread-vs-process per-core slopes
-    side by side.
+    side by side.  ``actor_inference="serve"`` measures the centralized
+    InferenceService path (ISSUE 3): fleets RPC a trainer-side act server
+    that batches across all of them, driven here by a dedicated serve
+    thread standing in for the fabric's ``inference_serve`` loop.
 
     The trainer only observes block-granular arrivals, and a lockstep
     fleet cuts ALL its lanes' blocks in the same iteration — arrivals are
@@ -224,14 +228,26 @@ def _actor_plane_bench_process(num_lanes: int = 64, fleets: int = 2,
     from r2d2_tpu.utils.math import epsilon_ladder
     from r2d2_tpu.utils.store import ParamStore
 
+    import threading
+
     cfg = pong_config(game_name="Fake", num_actors=num_lanes,
                       env_workers=env_workers, actor_fleets=fleets,
-                      actor_transport="process")
+                      actor_transport="process",
+                      actor_inference=actor_inference)
     net = create_network(cfg, 4)
     store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
     eps = [epsilon_ladder(i, num_lanes) for i in range(num_lanes)]
     plane = ProcessFleetPlane(cfg, 4, _bench_env_factory, eps)
     F = plane.num_fleets
+    serve_stop = threading.Event()
+    server = None
+    if plane.service is not None:
+        def _serve_loop():
+            while not serve_stop.is_set():
+                plane.service.serve_once()
+
+        server = threading.Thread(target=_serve_loop, daemon=True,
+                                  name="bench-serve")
     # a burst = one block per lane, so burst k starts at event index k*L
     lanes = [spec.hi - spec.lo for spec in plane.specs]
     need = [2 * L + 1 for L in lanes]     # through burst 2's first block
@@ -242,6 +258,8 @@ def _actor_plane_bench_process(num_lanes: int = 64, fleets: int = 2,
 
     try:
         plane.start(store)
+        if server is not None:
+            server.start()
         deadline = time.time() + budget_s
         while (time.time() < deadline
                and any(len(ev) < n for ev, n in zip(events, need))):
@@ -251,6 +269,12 @@ def _actor_plane_bench_process(num_lanes: int = 64, fleets: int = 2,
             src, n = got
             events[src].append((time.perf_counter(), n))
     finally:
+        # stop and JOIN the serve thread BEFORE plane.shutdown closes the
+        # act channels: a mid-iteration serve_once still holds slab views,
+        # and SharedMemory.close under live views raises BufferError
+        serve_stop.set()
+        if server is not None:
+            server.join(10)
         plane.shutdown()
 
     rate = 0.0

@@ -69,6 +69,8 @@ def build_config(args: argparse.Namespace) -> Config:
         kw["num_actors"] = args.actors
     if getattr(args, "actor_transport", None):
         kw["actor_transport"] = args.actor_transport
+    if getattr(args, "actor_inference", None):
+        kw["actor_inference"] = args.actor_inference
     if args.training_steps is not None:
         kw["training_steps"] = args.training_steps
     if args.seed is not None:
@@ -93,6 +95,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "process, fleet threads; default) or 'process' "
                         "(subprocess fleets over a shared-memory block "
                         "channel — use for GIL-bound envs / many cores)")
+    p.add_argument("--actor-inference", choices=("local", "serve"),
+                   default=None,
+                   help="process-transport acting: 'local' (each fleet "
+                        "runs its own CPU act twin; default) or 'serve' "
+                        "(fleets RPC a centralized InferenceService that "
+                        "batches across all fleets and acts once per step "
+                        "on the learner's backend)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--training-steps", type=int, default=None)
     p.add_argument("--set", dest="overrides", action="append",

@@ -121,6 +121,39 @@ class Config:
                                       # envs / multi-core hosts.  Fleet
                                       # inference runs on the host CPU
                                       # backend in this mode.
+    actor_inference: str = "local"    # process-transport acting:
+                                      # "local": each fleet subprocess
+                                      # runs its own CPU-jitted act twin
+                                      # (weights pumped per fleet).
+                                      # "serve": fleets stop running the
+                                      # network entirely — every env step
+                                      # is an RPC over a per-fleet
+                                      # shared-memory act slab to the
+                                      # trainer's InferenceService, which
+                                      # batches across ALL fleets and
+                                      # runs one device act per step with
+                                      # server-resident recurrent state
+                                      # and ~zero-staleness weights (the
+                                      # Sebulba/Seed-RL topology;
+                                      # parallel/inference_service.py).
+                                      # Thread transport ignores it (the
+                                      # fleets already share the
+                                      # trainer's act fn in-process)
+    param_pump_dtype: str = "float32" # wire dtype for process-fleet
+                                      # weight publication: "bfloat16"
+                                      # halves the per-fleet pickled
+                                      # snapshot (QuaRL: low-precision
+                                      # weight transport is ~free in RL);
+                                      # fleets cast back to float32 at
+                                      # publish, so acting math is
+                                      # unchanged — only the wire narrows
+    inference_batch_window: float = 0.002  # serve mode: after the first
+                                      # pending act request, wait up to
+                                      # this many seconds for the other
+                                      # lockstep fleets' requests before
+                                      # dispatching, so F singleton
+                                      # batches coalesce into one
+                                      # cross-fleet batch (0 disables)
     device_replay: bool = False       # replay data lives in HBM; batches
                                       # are gathered in-graph (device_ring)
     device_ring_layout: str = "auto"  # "replicated" (full ring per device)
@@ -258,6 +291,21 @@ class Config:
             raise ValueError(
                 f"unknown actor_transport {self.actor_transport!r} "
                 "(expected 'thread' or 'process')")
+        if self.actor_inference not in ("local", "serve"):
+            raise ValueError(
+                f"unknown actor_inference {self.actor_inference!r} "
+                "(expected 'local' or 'serve')")
+        if self.actor_inference == "serve" and self.actor_transport != "process":
+            raise ValueError(
+                "actor_inference='serve' requires actor_transport='process' "
+                "(thread fleets already share the trainer's act fn; the "
+                "inference service exists to centralize subprocess acting)")
+        if self.param_pump_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown param_pump_dtype {self.param_pump_dtype!r} "
+                "(expected 'float32' or 'bfloat16')")
+        if self.inference_batch_window < 0:
+            raise ValueError("inference_batch_window must be >= 0")
         if self.superstep_k < 1:
             raise ValueError("superstep_k must be >= 1")
         if self.superstep_pipeline < 0:

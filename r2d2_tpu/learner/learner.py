@@ -74,6 +74,7 @@ class Learner:
         self.start_minutes = start_minutes
         self._replicate_params = None  # lazily-built multihost resharder
         self._copy_params = None       # lazily-built one-dispatch snapshotter
+        self._saved_steps: set = set()  # steps THIS run saved (see _save)
 
         if mesh is not None:
             self._step_fn = sharded_train_step(cfg, net, mesh,
@@ -873,6 +874,18 @@ class Learner:
         return self._finish_device_run(losses_hist, t0)
 
     def _save(self, updates: int, t0: float) -> None:
+        if updates in self._saved_steps:
+            # THIS RUN already saved this step completely (the epilogue
+            # save lands on the same step as the last cadence save
+            # whenever training_steps % save_interval == 0).  Re-saving
+            # would have orbax delete-and-rewrite the payload under a
+            # sidecar that still marks it complete — a follow-mode
+            # evaluator restoring that step mid-rewrite sees a torn
+            # checkpoint.  Tracked per-run (not via has_meta): a fresh
+            # run reusing an old checkpoint dir must still overwrite the
+            # previous run's steps, and every pod process makes the same
+            # local decision so orbax's save barriers stay in sync.
+            return
         minutes = self.start_minutes + (time.time() - t0) / 60.0
         if jax.process_count() > 1:
             # Gather mp-sharded leaves that may live on other hosts by
@@ -898,3 +911,4 @@ class Learner:
                                          minutes=minutes,
                                          game=self.cfg.game_name,
                                          **arch_meta(self.cfg)))
+        self._saved_steps.add(updates)

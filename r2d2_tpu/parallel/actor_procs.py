@@ -34,10 +34,18 @@ reference's only genuinely parallel mechanism — N actor *processes*
   budget, after which the run stops instead of silently starving the
   buffer.
 
-Fleet inference always runs on the host CPU backend (a subprocess must
-not touch the trainer's accelerator client); params arrive as host numpy
-and commit to the fleet's local device once per refresh
-(actor.VectorActor._refresh_params).
+Fleet inference placement is ``cfg.actor_inference``: under ``"local"``
+(the default) it runs on the host CPU backend in every subprocess (a
+subprocess must not touch the trainer's accelerator client); params
+arrive as host numpy — optionally bf16 on the wire,
+``cfg.param_pump_dtype`` — and commit to the fleet's local device once
+per refresh (actor.VectorActor._refresh_params).  Under ``"serve"`` the
+fleets run no network at all: every env step is an RPC over a per-fleet
+shared-memory act slab to the trainer's
+:class:`~r2d2_tpu.parallel.inference_service.InferenceService`, which
+batches across all fleets, acts once on the learner's backend with
+server-resident recurrent state, and needs no weight queues (params are
+read straight from the ParamStore — ~zero staleness).
 
 ``cfg.actor_transport = "process"`` wires this through ``train()``;
 ``"thread"`` (the default) keeps the single-process fabric.  The env
@@ -48,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import multiprocessing as mp
+import pickle
 import time
 from multiprocessing import shared_memory
 from queue import Empty, Full
@@ -223,10 +232,25 @@ class _FleetSpec:
                             # trajectories into the PER buffer)
 
 
+def _decode_pump(payload: bytes):
+    """Worker-side decode of one pumped weight snapshot: unpickle the
+    shared blob and widen any bf16-on-the-wire leaves back to float32
+    (``cfg.param_pump_dtype="bfloat16"`` — QuaRL-style low-precision
+    transport; acting math stays f32 either way)."""
+    import jax
+    import ml_dtypes
+
+    version, params = pickle.loads(payload)
+    params = jax.tree.map(
+        lambda a: a.astype(np.float32)
+        if getattr(a, "dtype", None) == ml_dtypes.bfloat16 else a, params)
+    return version, params
+
+
 def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                        spec: _FleetSpec, producer_info, weights_q,
                        stop_event, ctrl_q=None, snap_q=None,
-                       restore_snap=None) -> None:
+                       restore_snap=None, act_info=None) -> None:
     """Entry point of one fleet subprocess.
 
     Pins JAX to the host CPU backend before any backend init (the child
@@ -240,6 +264,10 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
     can persist resumable actor state (checkpoint.save_replay).
     ``restore_snap`` resumes a previously-captured snapshot at spawn
     (full-state --resume).
+
+    ``act_info`` non-None selects serve mode: acting becomes an RPC
+    through a :class:`~r2d2_tpu.parallel.inference_service.
+    RemoteActClient` — no network, no weight wait, no drain thread.
     """
     import jax
 
@@ -252,35 +280,46 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
     from r2d2_tpu.utils.store import ParamStore
 
     store = ParamStore()
-    deadline = time.time() + 120.0
-    first = None
-    while first is None and not stop_event.is_set():
-        if time.time() > deadline:
-            raise RuntimeError(
-                f"fleet{spec.fleet_id}: no initial weights within 120 s")
-        try:
-            first = weights_q.get(timeout=0.2)
-        except Empty:
-            continue
-    if first is None:  # stopped before the first publication
-        return
-    store.publish(first[1])
+    client = None
+    if act_info is not None:
+        # serve mode: the trainer's InferenceService owns params and
+        # recurrent state; this process only steps envs and cuts blocks
+        from r2d2_tpu.parallel.inference_service import RemoteActClient
 
-    def weight_drain():
-        while not stop_event.is_set():
+        client = RemoteActClient(cfg, action_dim, spec.hi - spec.lo,
+                                 act_info, stop_event, src=spec.fleet_id)
+        act_fn = client
+    else:
+        deadline = time.time() + 120.0
+        first = None
+        while first is None and not stop_event.is_set():
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"fleet{spec.fleet_id}: no initial weights within 120 s")
             try:
-                _version, params = weights_q.get(timeout=0.2)
+                first = weights_q.get(timeout=0.2)
             except Empty:
                 continue
-            store.publish(params)
+        if first is None:  # stopped before the first publication
+            return
+        store.publish(_decode_pump(first)[1])
 
-    threading.Thread(target=weight_drain, daemon=True,
-                     name=f"fleet{spec.fleet_id}-weights").start()
+        def weight_drain():
+            while not stop_event.is_set():
+                try:
+                    payload = weights_q.get(timeout=0.2)
+                except Empty:
+                    continue
+                store.publish(_decode_pump(payload)[1])
+
+        threading.Thread(target=weight_drain, daemon=True,
+                         name=f"fleet{spec.fleet_id}-weights").start()
+
+        net = create_network(cfg, action_dim)
+        act_fn = make_act_fn(cfg, net)
 
     producer = ShmBlockProducer(cfg, action_dim, producer_info, stop_event,
                                 src=spec.fleet_id)
-    net = create_network(cfg, action_dim)
-    act_fn = make_act_fn(cfg, net)
     # incarnation shifts both the env seeds and the exploration stream so
     # a respawned fleet explores fresh trajectories instead of replaying
     # the ones its dead predecessor already contributed
@@ -333,6 +372,8 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                 e.close()
             except Exception:
                 pass
+        if client is not None:
+            client.close()
         producer.close()
 
 
@@ -348,11 +389,16 @@ class ProcessFleetPlane:
       ``sink=buffer.add``).
     - ``param_pump``: forwards new ParamStore versions to every fleet
       (throttled — at most ~5 snapshots/s regardless of the learner's
-      publish cadence).
+      publish cadence; one pickle per version shared across the F queue
+      puts, narrowed to bf16 on the wire under ``param_pump_dtype``).
+      Serve mode replaces it with ``inference_serve`` — the centralized
+      act server's loop (InferenceService.serve_once) — since weights
+      then never leave the trainer.
     - ``fleet_watch``: respawns dead fleet processes on their lane shard,
       up to ``max_restarts`` per fleet; an exhausted budget raises, which
       the Supervisor escalates to a fabric stop instead of a silent
-      starve.
+      starve.  A serve-mode respawn also retires the fleet's act channel
+      and zeroes its shard of the server-resident hidden state.
 
     ``shutdown()`` stops the fleets (event + join, terminate as a last
     resort) and unlinks the shared memory.  Each fleet owns a private
@@ -382,6 +428,14 @@ class ProcessFleetPlane:
             for f, (lo, hi) in enumerate(shards)
         ]
         F = len(self.specs)
+        # serve mode: the trainer-side act server (channels created per
+        # spawn, hidden state per global lane; parallel/inference_service)
+        self.service = None
+        if cfg.actor_inference == "serve":
+            from r2d2_tpu.parallel.inference_service import InferenceService
+
+            self.service = InferenceService(cfg, action_dim, self.specs,
+                                            self.ctx)
         self.channels: List[Optional[ShmBlockChannel]] = [None] * F
         self._graveyard: List[ShmBlockChannel] = []
         self.stop_event = self.ctx.Event()
@@ -411,42 +465,65 @@ class ProcessFleetPlane:
 
     # ------------------------------------------------------------ weights
     def _snapshot_params(self):
-        """Latest published params as a host-numpy pytree, or None."""
+        """Latest published params as a host-numpy pytree (narrowed to
+        bf16 on the wire when ``cfg.param_pump_dtype="bfloat16"`` — the
+        worker widens back to f32 at publish, :func:`_decode_pump`), or
+        None."""
         import jax
 
         version, params = self.param_store.get()
         if params is None:
             return None, 0
-        return jax.device_get(params), version
+        host = jax.device_get(params)
+        if self.cfg.param_pump_dtype == "bfloat16":
+            import ml_dtypes
 
-    def _prime(self, f: int, payload) -> None:
-        """Best-effort put of a weight snapshot to fleet ``f``'s queue,
-        displacing a stale one if the queue is full."""
-        version, host = payload
+            host = jax.tree.map(
+                lambda a: a.astype(ml_dtypes.bfloat16)
+                if a.dtype == np.float32 else a, host)
+        return host, version
+
+    @staticmethod
+    def _encode_pump(version: int, host) -> bytes:
+        """Pickle one pump payload ONCE.  Every fleet queue put then ships
+        the same bytes blob — an mp.Queue put pickles its item, so putting
+        the raw tree F times serialised the full host pytree once per
+        fleet per version; re-pickling pre-pickled bytes is a memcpy."""
+        return pickle.dumps((version, host),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _prime(self, f: int, payload: bytes) -> None:
+        """Best-effort put of an encoded weight snapshot to fleet ``f``'s
+        queue, displacing a stale one if the queue is full."""
         q = self.weight_queues[f]
         try:
-            q.put_nowait((version, host))
+            q.put_nowait(payload)
         except Full:
             try:
                 q.get_nowait()
             except Empty:
                 pass
             try:
-                q.put_nowait((version, host))
+                q.put_nowait(payload)
             except Full:
                 pass
 
     def pump_params_once(self) -> bool:
         """Forward the current ParamStore version to every fleet if it is
-        newer than the last pumped one.  Returns True if it pumped."""
+        newer than the last pumped one.  Returns True if it pumped.
+        Serve mode pumps nothing — the service reads the ParamStore
+        directly."""
+        if self.service is not None:
+            return False
         version, _ = self.param_store.get()
         if version == self._pumped_version:
             return False
         host, version = self._snapshot_params()
         if host is None:
             return False
+        blob = self._encode_pump(version, host)
         for f in range(self.num_fleets):
-            self._prime(f, (version, host))
+            self._prime(f, blob)
         self._pumped_version = version
         return True
 
@@ -461,11 +538,14 @@ class ProcessFleetPlane:
         thread may still hold views into it); its in-flight blocks are
         dropped, like any crash-lost experience.
 
-        ``payload`` is a prefetched ``(version, host_params)`` weight
-        snapshot (start() shares one across all fleets rather than
-        paying F device→host transfers); None re-snapshots — the
-        watchdog respawn path, where the predecessor consumed the queued
-        snapshot and the version may not have changed."""
+        ``payload`` is a prefetched, pre-encoded weight snapshot blob
+        (start() shares ONE pickle across all fleets rather than paying F
+        device→host transfers + F serialisations); None re-snapshots —
+        the watchdog respawn path, where the predecessor consumed the
+        queued snapshot and the version may not have changed.  Serve mode
+        skips weights entirely and provisions the fleet's act channel
+        instead, zeroing (respawn) or restoring (--resume) its shard of
+        the server-resident hidden state."""
         old = self.channels[f]
         if old is not None:
             try:
@@ -475,26 +555,46 @@ class ProcessFleetPlane:
             self._graveyard.append(old)
         self.channels[f] = ShmBlockChannel(self.cfg, self.action_dim,
                                            self.SLOTS_PER_FLEET, self.ctx)
-        self.weight_queues[f] = self.ctx.Queue(maxsize=2)
         # fleet-private like every other queue (SIGKILL corruption must
         # not cross fleets); fresh per spawn for the same reason
         self.ctrl_queues[f] = self.ctx.Queue()
         self.snap_queues[f] = self.ctx.Queue()
-        # prime BEFORE start so the child finds its initial weights
-        if payload is None:
-            host, version = self._snapshot_params()
-            payload = (version, host)
-        if payload[1] is not None:
-            self._prime(f, payload)
+        act_info = None
+        if self.service is not None:
+            self.weight_queues[f] = None
+            act_info = self.service.make_channel(f).producer_info()
+        else:
+            self.weight_queues[f] = self.ctx.Queue(maxsize=2)
+            # prime BEFORE start so the child finds its initial weights
+            if payload is None:
+                host, version = self._snapshot_params()
+                if host is not None:
+                    payload = self._encode_pump(version, host)
+            if payload is not None:
+                self._prime(f, payload)
         spec = dataclasses.replace(self.specs[f],
                                    incarnation=self.restarts[f])
         restore_snap, self._restore_snaps[f] = self._restore_snaps[f], None
+        if self.service is not None:
+            restored = False
+            if restore_snap is not None:
+                try:
+                    self.service.load_shard_hidden(
+                        f, np.asarray(restore_snap["agent"]["hidden"],
+                                      np.float32))
+                    restored = True
+                except Exception as e:
+                    log.warning("fleet%d: server hidden not restored (%s)",
+                                f, e)
+            if not restored:
+                # respawn/cold spawn: no stale recurrent state may survive
+                self.service.reset_shard(f)
         p = self.ctx.Process(
             target=_fleet_worker_main, name=f"fleet{f}",
             args=(self.cfg, self.action_dim, self.env_factory, spec,
                   self.channels[f].producer_info(), self.weight_queues[f],
                   self.stop_event, self.ctrl_queues[f], self.snap_queues[f],
-                  restore_snap),
+                  restore_snap, act_info),
             daemon=True)
         p.start()
         self.procs[f] = p
@@ -518,11 +618,18 @@ class ProcessFleetPlane:
         """Spawn every fleet.  ``param_store`` must already hold the
         initial publication (Learner.__init__ publishes v1)."""
         self.param_store = param_store
-        # ONE device→host transfer shared by every fleet's priming
-        host, version = self._snapshot_params()
-        self._pumped_version = version
+        payload = None
+        if self.service is not None:
+            self.service.start(param_store)
+        else:
+            # ONE device→host transfer AND one pickle shared by every
+            # fleet's priming
+            host, version = self._snapshot_params()
+            self._pumped_version = version
+            if host is not None:
+                payload = self._encode_pump(version, host)
         for f in range(self.num_fleets):
-            self._spawn(f, payload=(version, host))
+            self._spawn(f, payload=payload)
 
     def watch_once(self) -> int:
         """Respawn any dead fleet process (skipped while shutting down).
@@ -599,7 +706,9 @@ class ProcessFleetPlane:
         return None
 
     def make_loops(self, stop: Callable[[], bool], sink: BlockSink):
-        """The plane's three supervised fabric loops for ``train()``."""
+        """The plane's supervised fabric loops for ``train()``: block
+        ingest, process watchdog, and either the weight pump (local
+        inference) or the batched act server (serve mode)."""
 
         def fleet_ingest():
             while not stop():
@@ -610,16 +719,25 @@ class ProcessFleetPlane:
                 self.pump_params_once()
                 time.sleep(0.2)
 
+        def inference_serve():
+            while not stop():
+                self.service.serve_once()
+
         def fleet_watch():
             while not stop():
                 self.watch_once()
                 time.sleep(0.25)
 
-        return [("fleet_ingest", fleet_ingest), ("param_pump", param_pump),
-                ("fleet_watch", fleet_watch)]
+        loops = [("fleet_ingest", fleet_ingest)]
+        if self.service is not None:
+            loops.append(("inference_serve", inference_serve))
+        else:
+            loops.append(("param_pump", param_pump))
+        loops.append(("fleet_watch", fleet_watch))
+        return loops
 
     def health(self) -> dict:
-        return dict(
+        out = dict(
             fleets=self.num_fleets,
             alive=sum(1 for p in self.procs
                       if p is not None and p.is_alive()),
@@ -630,6 +748,9 @@ class ProcessFleetPlane:
             blocks_corrupt=self.blocks_corrupt,
             blocks_per_fleet=list(self.blocks_per_fleet),
         )
+        if self.service is not None:
+            out["service"] = self.service.health()
+        return out
 
     # ----------------------------------------------------------- shutdown
     def shutdown(self, timeout: float = 10.0, snapshot: bool = False
@@ -674,4 +795,6 @@ class ProcessFleetPlane:
         for ch in list(self.channels) + self._graveyard:
             if ch is not None:
                 ch.close()
+        if self.service is not None:
+            self.service.close()
         return snaps

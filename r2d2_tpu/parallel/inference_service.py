@@ -1,0 +1,496 @@
+"""Centralized batched inference for the process actor plane.
+
+With ``cfg.actor_transport="process"`` and the default
+``actor_inference="local"``, every fleet subprocess runs its own CPU-jitted
+copy of the acting network — the accelerator does zero acting work and N
+fleets burn N host cores re-running the same forward at batch ≈ lanes/F.
+The Podracer (Sebulba) and Seed-RL architectures centralize instead:
+actors ship observations to one server that batches across ALL of them and
+runs a single large-batch device ``act`` — exactly the "batched inference
+amortizes device dispatch" design the lockstep :class:`~r2d2_tpu.actor.
+VectorActor` already implements *within* one process, lifted across the
+process boundary.  ``cfg.actor_inference="serve"`` wires it:
+
+- **Act slab**: each fleet owns one preallocated shared-memory
+  request/response slot (:func:`act_slot_spec`, laid out by the replay
+  ring's own :func:`~r2d2_tpu.replay.block.slot_layout`).  Every env step
+  the fleet writes ``(obs, last_action, last_reward, reset_mask)`` for its
+  lane shard, posts a sequence token on its request queue, and blocks on
+  the response queue; the reply carries ``(q, new_hidden)`` views into the
+  same slab.  A CRC32 integrity word — written last, covering the payload
+  plus the token header, the block channel's own convention — lets the
+  server detect a garbled request (counted + logged; still served, since
+  dropping it would wedge the lockstep fleet forever).
+- **Server-resident recurrent state**: ONE ``(num_actors, 2, layers, H)``
+  hidden array lives in the :class:`InferenceService`, indexed by global
+  lane id via the fleet shards, zeroed by each request's reset mask, and
+  zeroed shard-wide when the watchdog respawns a fleet (no stale LSTM
+  state can survive a crash).  The response carries the post-step hidden
+  rows so the fleet can record the R2D2 stored-state scheme into its
+  blocks (replay needs hidden at each sequence's burn-in start) — but the
+  server's copy is authoritative: the client never sends hidden, and the
+  full-state snapshot restores the server array bit-exact from the
+  per-fleet actor snapshots (``ProcessFleetPlane._spawn``).
+- **Zero-staleness weights**: the service reads params straight from the
+  trainer's ParamStore each batch — serve-mode fleets need no weight
+  queues, no per-fleet pickled snapshots, no refresh cadence at all.
+- **Peek requests**: the episode-step-cap bootstrap needs Q at the
+  post-step state *without* advancing recurrent state (the VectorActor
+  calls act twice that iteration).  A request with ``commit=0`` computes
+  q but neither applies reset masks nor scatters hidden.
+
+Intentional divergence from a strict Seed-RL split: the ε-greedy draw
+stays fleet-side (the response carries the full q row, tiny at Atari
+action counts) so the exploration RNG remains part of the resumable actor
+snapshot — the recovery machinery's bit-exact resume guarantees survive
+serve mode unchanged.
+
+The service loop runs as a supervised fabric thread
+(``ProcessFleetPlane.make_loops`` → ``inference_serve``); ``serve_once``
+is re-enterable (pending requests survive a supervisor restart).  Device
+placement follows ``cfg.act_device``, with ``"auto"`` resolving to the
+**default backend** (the learner's accelerator) rather than the local-mode
+CPU twin — centralizing inference exists to put the accelerator back on
+the acting path.  On a CPU-only host (tier-1 tests under
+``JAX_PLATFORMS=cpu``) that same resolution lands on the CPU act twin.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+import zlib
+from multiprocessing import shared_memory
+from queue import Empty
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.parallel.actor_procs import FleetStopped
+from r2d2_tpu.replay.block import slot_layout, slot_views
+
+log = logging.getLogger(__name__)
+
+# request payload fields, in CRC order (shared by producer + verifier)
+_REQ_FIELDS = ("obs", "last_action", "last_reward", "reset_mask")
+
+
+def act_slot_spec(cfg: Config, action_dim: int, num_lanes: int):
+    """(name, shape, dtype) of ONE fleet's act request/response slot.
+
+    Request region (fleet-written): the batched AgentState the act fn
+    consumes, minus hidden (server-resident), plus the reset mask and the
+    CRC32 integrity word.  Response region (server-written): the q row
+    per lane and the post-step hidden rows for block recording."""
+    n = num_lanes
+    return (
+        ("obs", (n, *cfg.stored_obs_shape), np.uint8),
+        ("last_action", (n, action_dim), np.float32),
+        ("last_reward", (n,), np.float32),
+        ("reset_mask", (n,), np.uint8),
+        ("req_crc", (1,), np.uint32),
+        ("q", (n, action_dim), np.float32),
+        ("rsp_hidden", (n, 2, cfg.lstm_layers, cfg.hidden_dim), np.float32),
+    )
+
+
+def act_request_crc(views: dict, seq: int, commit: bool) -> int:
+    """CRC32 over the request payload plus the queue token header, so a
+    slab/token mismatch is caught along with a torn or garbled write."""
+    c = zlib.crc32(np.asarray([seq, int(commit)], np.int64).tobytes())
+    for name in _REQ_FIELDS:
+        c = zlib.crc32(views[name].tobytes(), c)
+    return c & 0xFFFFFFFF
+
+
+def _span(tracer, name: str):
+    return tracer.span(name) if tracer is not None else (
+        contextlib.nullcontext())
+
+
+class ActChannel:
+    """Trainer-side end of ONE fleet's inference RPC transport: the act
+    slab plus the two token queues.  Fleet-private and retired wholesale
+    on respawn, exactly like the block channel — a SIGKILLed process can
+    die holding a queue's pipe lock, and corruption must not outlive the
+    process that caused it."""
+
+    def __init__(self, cfg: Config, action_dim: int, num_lanes: int, ctx):
+        self.num_lanes = num_lanes
+        self.spec = act_slot_spec(cfg, action_dim, num_lanes)
+        self.nbytes, self.offsets = slot_layout(self.spec)
+        self.shm = shared_memory.SharedMemory(create=True, size=self.nbytes)
+        self.req_q = ctx.Queue()
+        self.rsp_q = ctx.Queue()
+        self.views = slot_views(self.shm.buf, self.spec, self.offsets,
+                                self.nbytes, 0)
+
+    def producer_info(self) -> Tuple[str, Any, Any]:
+        """The picklable handle the fleet child needs to attach
+        (:class:`RemoteActClient`)."""
+        return (self.shm.name, self.req_q, self.rsp_q)
+
+    def close(self) -> None:
+        self.views = None
+        try:
+            self.shm.close()
+        except BufferError:
+            # a straggler thread still holds slab views; the mapping dies
+            # with the process — unlinking below still frees the name
+            pass
+        try:
+            self.shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+class RemoteActClient:
+    """Fleet-side act function: each call is one RPC over the act slab.
+
+    Conforms to the ``make_act_fn`` signature ``(params, obs, last_action,
+    last_reward, hidden) → (q, new_hidden)`` so it plugs straight into a
+    VectorActor — ``params`` and ``hidden`` are ignored (both live in the
+    trainer's InferenceService).  The returned arrays are views into the
+    slab, valid until the next call (the actor's per-iteration reads all
+    complete before then).  Waiting polls ``stop_event`` so shutdown never
+    hangs a fleet mid-step (raises FleetStopped, like the block
+    producer)."""
+
+    RESPONSE_TIMEOUT = 600.0   # orphan bound: trainer SIGKILLed mid-rpc
+
+    def __init__(self, cfg: Config, action_dim: int, num_lanes: int,
+                 info: Tuple[str, Any, Any], stop_event, src: int = 0):
+        name, self.req_q, self.rsp_q = info
+        self.shm = shared_memory.SharedMemory(name=name)
+        self.spec = act_slot_spec(cfg, action_dim, num_lanes)
+        nbytes, offsets = slot_layout(self.spec)
+        self.views = slot_views(self.shm.buf, self.spec, offsets, nbytes, 0)
+        self.num_lanes = num_lanes
+        self.stop_event = stop_event
+        self.src = src
+        self._seq = 0
+        # lanes whose server-side hidden must be zeroed at the next commit
+        # request; starts all-pending (a fresh incarnation's lanes all
+        # begin a new episode, and a respawn must never inherit state)
+        self._pending_resets = set(range(num_lanes))
+
+    # --------------------------------------------------- VectorActor hooks
+    def note_reset(self, lane: int) -> None:
+        """VectorActor._reset_lane: lane ``lane`` starts a fresh episode —
+        its server-resident hidden is zeroed at the next commit request."""
+        self._pending_resets.add(int(lane))
+
+    def clear_reset_notes(self) -> None:
+        """VectorActor.restore: lanes resuming mid-episode must NOT zero
+        the server hidden the snapshot just restored; non-resumable lanes
+        re-note themselves through their reset."""
+        self._pending_resets.clear()
+
+    def __call__(self, params, obs, last_action, last_reward, hidden):
+        return self._rpc(obs, last_action, last_reward, commit=True)
+
+    def peek(self, params, obs, last_action, last_reward, hidden):
+        """Bootstrap forward (episode-step cap): q at the given inputs
+        WITHOUT advancing server state — no reset application, no hidden
+        scatter.  Returns ``(q, None)``."""
+        return self._rpc(obs, last_action, last_reward, commit=False)
+
+    # -------------------------------------------------------------- rpc
+    def _rpc(self, obs, last_action, last_reward, commit: bool):
+        v = self.views
+        v["obs"][:] = obs
+        v["last_action"][:] = last_action
+        v["last_reward"][:] = last_reward
+        mask = np.zeros(self.num_lanes, np.uint8)
+        if commit and self._pending_resets:
+            mask[sorted(self._pending_resets)] = 1
+        v["reset_mask"][:] = mask
+        self._seq += 1
+        # CRC last: the slab is only valid once the integrity word matches
+        v["req_crc"][0] = act_request_crc(v, self._seq, commit)
+        self.req_q.put((self._seq, int(commit)))
+        deadline = time.time() + self.RESPONSE_TIMEOUT
+        while True:
+            if self.stop_event.is_set():
+                raise FleetStopped
+            try:
+                seq = self.rsp_q.get(timeout=0.2)
+            except Empty:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"fleet{self.src}: no inference response within "
+                        f"{self.RESPONSE_TIMEOUT:.0f} s — trainer gone?")
+                continue
+            if seq == self._seq:
+                break
+            # stale token from a retired incarnation's race: ignore
+        if commit:
+            self._pending_resets.clear()
+            return v["q"], v["rsp_hidden"]
+        return v["q"], None
+
+    def close(self) -> None:
+        try:
+            self.views = None
+            self.shm.close()
+        except Exception:
+            pass
+
+
+class InferenceService:
+    """The trainer-side act server for every serve-mode fleet.
+
+    Owns the per-fleet :class:`ActChannel`\\ s (created/retired by
+    ``ProcessFleetPlane._spawn``), the server-resident hidden array, and
+    the jitted act function on the resolved device.  ``serve_once`` is the
+    supervised fabric loop body: drain pending request tokens, give the
+    other lockstep fleets ``cfg.inference_batch_window`` seconds to catch
+    up (cross-fleet batching), run ONE full-batch act, scatter replies.
+
+    The act always runs at the full ``num_actors`` batch (non-pending
+    lanes carry stale scratch rows whose outputs are discarded): one
+    compiled executable regardless of which fleet subset is pending, and
+    the common case — lockstep fleets all pending — wastes nothing.
+    """
+
+    def __init__(self, cfg: Config, action_dim: int, specs: Sequence[Any],
+                 ctx):
+        self.cfg = cfg
+        self.action_dim = action_dim
+        self.specs = list(specs)          # per-fleet (fleet_id, lo, hi)
+        self.ctx = ctx
+        F = len(self.specs)
+        self.channels: List[Optional[ActChannel]] = [None] * F
+        self._graveyard: List[ActChannel] = []
+        N = cfg.num_actors
+        self.hidden = np.zeros((N, 2, cfg.lstm_layers, cfg.hidden_dim),
+                               np.float32)
+        self._hidden_lock = threading.Lock()
+        # full-batch request scratch, indexed by global lane id
+        self.obs = np.zeros((N, *cfg.stored_obs_shape), np.uint8)
+        self.last_action = np.zeros((N, action_dim), np.float32)
+        self.last_reward = np.zeros(N, np.float32)
+        # fleet -> (seq, commit, channel): drained-but-unanswered requests;
+        # kept as service state so a supervisor restart of the serve loop
+        # resumes and answers instead of wedging the blocked fleets
+        self._pending: dict = {}
+        self.param_store = None
+        self._act = None
+        self._params = None
+        self._param_version = 0
+        self.tracer = None                # set by train(); spans optional
+        self.batches = 0
+        self.lanes_served = 0
+        self.last_batch_lanes = 0
+        self.peeks = 0
+        self.requests_corrupt = 0
+
+    # ------------------------------------------------------------ channels
+    def make_channel(self, f: int) -> ActChannel:
+        """Fresh act channel for fleet ``f``, retiring any predecessor
+        (unlink now, keep mapped — the serve loop may hold views; same
+        discipline as the block channels)."""
+        old = self.channels[f]
+        if old is not None:
+            try:
+                old.shm.unlink()
+            except FileNotFoundError:
+                pass
+            self._graveyard.append(old)
+        self._pending.pop(f, None)   # the dead incarnation's request
+        spec = self.specs[f]
+        ch = ActChannel(self.cfg, self.action_dim, spec.hi - spec.lo,
+                        self.ctx)
+        self.channels[f] = ch
+        return ch
+
+    # -------------------------------------------------------- hidden state
+    def reset_shard(self, f: int) -> None:
+        """Zero fleet ``f``'s server-resident hidden lanes — the watchdog
+        respawn path: a replacement fleet must never act on its dead
+        predecessor's recurrent state."""
+        spec = self.specs[f]
+        with self._hidden_lock:
+            self.hidden[spec.lo:spec.hi] = 0.0
+
+    def load_shard_hidden(self, f: int, hidden: np.ndarray) -> None:
+        """Restore fleet ``f``'s hidden lanes from its actor snapshot
+        (full-state --resume).  A geometry mismatch zeroes instead — the
+        lanes resume cold, consistent with the actor-side fallback."""
+        spec = self.specs[f]
+        with self._hidden_lock:
+            if hidden.shape != self.hidden[spec.lo:spec.hi].shape:
+                log.warning(
+                    "fleet%d: snapshot hidden %s does not match shard %s — "
+                    "zeroing", f, hidden.shape,
+                    self.hidden[spec.lo:spec.hi].shape)
+                self.hidden[spec.lo:spec.hi] = 0.0
+            else:
+                self.hidden[spec.lo:spec.hi] = hidden
+
+    # ---------------------------------------------------------------- act
+    def start(self, param_store) -> None:
+        self.param_store = param_store
+        if self._act is None:
+            from r2d2_tpu.actor import make_act_fn
+            from r2d2_tpu.models.network import create_network
+
+            # "auto" resolves to the DEFAULT backend here (the learner's
+            # accelerator — centralized inference exists to use it), not
+            # local mode's CPU twin; "cpu" still forces the CPU twin, and
+            # on a CPU-only host both land on the same scan/f32 twin
+            dev = ("default" if self.cfg.act_device == "auto"
+                   else self.cfg.act_device)
+            acfg = self.cfg.replace(act_device=dev)
+            self._act = make_act_fn(acfg, create_network(acfg,
+                                                         self.action_dim))
+
+    def _refresh_params(self) -> None:
+        """Adopt the newest ParamStore publication.  Single-host, params
+        are the learner's own device arrays — zero copies, ~zero
+        staleness; multi-host publishes host arrays, committed to a local
+        device once per version (VectorActor._refresh_params's rule)."""
+        version, params = self.param_store.get()
+        if params is None or version == self._param_version:
+            return
+        import jax
+
+        if isinstance(jax.tree.leaves(params)[0], np.ndarray):
+            params = jax.device_put(params, jax.local_devices()[0])
+        self._params = params
+        self._param_version = version
+
+    # --------------------------------------------------------------- serve
+    def _drain(self, f: int) -> bool:
+        """Pull one pending request token from fleet ``f`` (non-blocking).
+        The channel is captured WITH the token: a watchdog respawn may
+        retire it concurrently, and the reply must go to the slab the
+        request was written into, not its replacement's."""
+        ch = self.channels[f]
+        if ch is None or f in self._pending:
+            return False
+        try:
+            seq, commit = ch.req_q.get_nowait()
+        except Empty:
+            return False
+        except Exception:
+            return False   # retired channel / corrupted pipe: respawn path
+        if int(ch.views["req_crc"][0]) != act_request_crc(ch.views, seq,
+                                                          commit):
+            # garbled slab (chaos, torn producer): count + surface, but
+            # still serve — dropping the reply would wedge the lockstep
+            # fleet forever, and the experience CRC on the block channel
+            # independently protects the replay ring
+            self.requests_corrupt += 1
+            log.warning("fleet%d: act request %d failed CRC32 — serving "
+                        "anyway (counted)", f, seq)
+        self._pending[f] = (seq, bool(commit), ch)
+        return True
+
+    def serve_once(self, idle_sleep: float = 0.001) -> int:
+        """One service iteration: gather pending requests, act, scatter.
+        Returns the number of lanes served (0 when idle)."""
+        F = len(self.specs)
+        for f in range(F):
+            self._drain(f)
+        if not self._pending:
+            if idle_sleep > 0:
+                time.sleep(idle_sleep)
+            return 0
+        # batch window: lockstep peers post within microseconds of each
+        # other in steady state — a short wait turns F singleton batches
+        # into one cross-fleet batch
+        if len(self._pending) < F and self.cfg.inference_batch_window > 0:
+            deadline = time.monotonic() + self.cfg.inference_batch_window
+            while len(self._pending) < F and time.monotonic() < deadline:
+                if not any(self._drain(f) for f in range(F)):
+                    time.sleep(0.0002)
+        self._refresh_params()
+        if self._params is None:   # no publication yet: keep requests
+            time.sleep(idle_sleep)
+            return 0
+        tr = self.tracer
+        pend = sorted(self._pending)
+        with _span(tr, "serve.assemble"):
+            with self._hidden_lock:
+                for f in list(pend):
+                    item = self._pending.get(f)
+                    if item is None:
+                        # the watchdog retired this fleet (make_channel
+                        # pops its pending request) between our snapshot
+                        # and now — the requester is dead, skip it
+                        pend.remove(f)
+                        continue
+                    _seq, commit, ch = item
+                    spec = self.specs[f]
+                    lo, hi = spec.lo, spec.hi
+                    v = ch.views
+                    self.obs[lo:hi] = v["obs"]
+                    self.last_action[lo:hi] = v["last_action"]
+                    self.last_reward[lo:hi] = v["last_reward"]
+                    if commit:
+                        resets = np.nonzero(v["reset_mask"])[0]
+                        if resets.size:
+                            self.hidden[lo + resets] = 0.0
+                # consistent snapshot: a concurrent reset_shard (watchdog
+                # respawn) must not tear mid-act
+                hidden_in = self.hidden.copy()
+        if not pend:
+            return 0
+        with _span(tr, "serve.act"):
+            q, new_hidden = self._act(self._params, self.obs,
+                                      self.last_action, self.last_reward,
+                                      hidden_in)
+            q = np.asarray(q)
+            new_hidden = np.asarray(new_hidden)
+        lanes = 0
+        with _span(tr, "serve.scatter"):
+            with self._hidden_lock:
+                for f in pend:
+                    item = self._pending.pop(f, None)
+                    if item is None:   # fleet retired mid-batch; see above
+                        continue
+                    seq, commit, ch = item
+                    spec = self.specs[f]
+                    lo, hi = spec.lo, spec.hi
+                    ch.views["q"][:] = q[lo:hi]
+                    if commit:
+                        ch.views["rsp_hidden"][:] = new_hidden[lo:hi]
+                        # only pending lanes advance; idle fleets' state
+                        # is untouched by the full-batch act
+                        self.hidden[lo:hi] = new_hidden[lo:hi]
+                    else:
+                        self.peeks += 1
+                    lanes += hi - lo
+                    try:
+                        ch.rsp_q.put(seq)
+                    except Exception:
+                        pass   # fleet died mid-rpc; the watchdog respawns
+        self.batches += 1
+        self.lanes_served += lanes
+        self.last_batch_lanes = lanes
+        if tr is not None:
+            tr.gauge("serve.batch_lanes", lanes)
+        return lanes
+
+    # --------------------------------------------------------------- misc
+    def health(self) -> dict:
+        """Service stats for fleet health / train logs — the cross-fleet
+        batch size is the headline (acceptance: observable per round)."""
+        return dict(
+            batches=self.batches,
+            lanes_served=self.lanes_served,
+            last_batch_lanes=self.last_batch_lanes,
+            mean_batch_lanes=round(self.lanes_served / self.batches, 2)
+            if self.batches else 0.0,
+            peeks=self.peeks,
+            requests_corrupt=self.requests_corrupt,
+            param_version=self._param_version,
+        )
+
+    def close(self) -> None:
+        for ch in list(self.channels) + self._graveyard:
+            if ch is not None:
+                ch.close()

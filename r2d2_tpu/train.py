@@ -31,7 +31,11 @@ for subprocess fleets (parallel/actor_procs.py): blocks come back over a
 preallocated shared-memory channel and weights go out on a versioned
 publication queue — the reference's N-process acting topology
 (train.py:30-34) for GIL-bound envs / multi-core hosts; the rest of the
-fabric (replay, learner, supervision) is unchanged.
+fabric (replay, learner, supervision) is unchanged.  On top of it,
+``cfg.actor_inference = "serve"`` centralizes acting (Sebulba/Seed-RL):
+the fleets stop running the network and every env step becomes an RPC to
+an InferenceService fabric thread that batches across all fleets and
+runs one device act per step (parallel/inference_service.py).
 """
 from __future__ import annotations
 
@@ -321,7 +325,8 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     # after every single update)
     cfg = cfg.replace(prefetch_batches=0, env_workers=0, actor_fleets=1,
                       device_replay=False, in_graph_per=False,
-                      superstep_pipeline=0, actor_transport="thread")
+                      superstep_pipeline=0, actor_transport="thread",
+                      actor_inference="local")
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     cfg = sys["cfg"]
     actor: VectorActor = sys["actor"]
@@ -417,6 +422,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     if plane is not None:
         # CRC-failed blocks dropped at ingest surface in buffer.stats()
         plane.on_corrupt = buffer.note_corrupt_block
+        if plane.service is not None:
+            # serve loop spans (assemble/act/scatter) + batch-size gauge
+            # land in the same tracer snapshot as every other stage
+            plane.service.tracer = tracer
 
     stop_event = threading.Event()
     deadline = (time.time() + max_wall_seconds) if max_wall_seconds else None

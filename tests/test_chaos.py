@@ -241,3 +241,74 @@ def test_chaos_kill_fleet_respawned_on_shard():
     finally:
         plane.shutdown()
     assert all(p is None or not p.is_alive() for p in plane.procs)
+
+
+@pytest.mark.timeout(600)
+def test_chaos_kill_fleet_serve_zeroes_server_hidden():
+    """Serve-mode recovery drill (ISSUE 3): chaos SIGKILLs a serve-mode
+    fleet; the watchdog respawn must zero EXACTLY that shard's
+    server-resident hidden lanes (no stale recurrent state can leak into
+    the replacement) while the surviving fleet's lanes are untouched —
+    and blocks must flow again afterwards."""
+    import jax
+
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane
+    from r2d2_tpu.utils.store import ParamStore
+    from test_actor_procs import make_fake_env
+
+    cfg = make_test_config(game_name="Fake", num_actors=2, actor_fleets=2,
+                           actor_transport="process",
+                           actor_inference="serve")
+    net = create_network(cfg, A)
+    store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3],
+                              max_restarts=2)
+    svc = plane.service
+    inj = ChaosInjector("kill_fleet:at=1", seed=0)
+    got = []
+
+    def drain(n, budget):
+        deadline = time.time() + budget
+        while len(got) < n and time.time() < deadline:
+            svc.serve_once(idle_sleep=0.0)
+            plane.ingest_once(lambda b, p, e: got.append(1), timeout=0.01)
+        return len(got) >= n
+
+    try:
+        plane.start(store)
+        assert drain(2, 120), "no blocks before the injected kill"
+        # wait until BOTH shards have acted (a lagging spawn could leave
+        # one shard's hidden still zero, making the post-kill asserts
+        # vacuous/flaky) — keep serving until each holds recurrent state
+        deadline = time.time() + 120
+        while not all(np.any(svc.hidden[s.lo:s.hi] != 0)
+                      for s in plane.specs):
+            assert time.time() < deadline, "a fleet never acted"
+            svc.serve_once(idle_sleep=0.0)
+            plane.ingest_once(lambda b, p, e: got.append(1), timeout=0.01)
+        victim = inj.maybe_kill_fleet(plane)
+        assert victim is not None
+        survivor = 1 - victim
+        plane.procs[victim].join(15)
+        assert not plane.procs[victim].is_alive()
+        v_lo, v_hi = plane.specs[victim].lo, plane.specs[victim].hi
+        s_lo, s_hi = plane.specs[survivor].lo, plane.specs[survivor].hi
+        assert np.any(svc.hidden[v_lo:v_hi] != 0)
+        survivor_hidden = svc.hidden[s_lo:s_hi].copy()
+
+        deadline = time.time() + 30
+        while plane.watch_once() == 0:
+            assert time.time() < deadline, "watchdog never saw the death"
+            time.sleep(0.1)
+        # the respawn zeroed exactly the victim's server-resident lanes
+        np.testing.assert_array_equal(svc.hidden[v_lo:v_hi], 0.0)
+        np.testing.assert_array_equal(svc.hidden[s_lo:s_hi],
+                                      survivor_hidden)
+        assert plane.restarts[victim] == 1 and not plane.failed
+
+        n0 = len(got)
+        assert drain(n0 + 2, 120), "no blocks after the serve respawn"
+    finally:
+        plane.shutdown()
+    assert all(p is None or not p.is_alive() for p in plane.procs)

@@ -1,0 +1,344 @@
+"""Centralized batched inference service (ISSUE 3 tentpole,
+parallel/inference_service.py): serve-mode acting must be bit-identical
+to local inference (blocks, priorities, stored hidden), the act slab's
+CRC convention must surface garbled requests, weight pumping must pickle
+once per version (and optionally narrow to bf16 on the wire), and the
+full train() fabric must run green with ``actor_inference="serve"``.
+
+All of it runs tier-1-safe under ``JAX_PLATFORMS=cpu``: the service's
+``act_device="auto"`` resolution lands on the CPU act twin there (the
+same executable local mode uses), which is what makes the bit-exactness
+assertions possible at all.
+"""
+import multiprocessing as mp
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.actor import VectorActor, make_act_fn
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane, _decode_pump
+from r2d2_tpu.parallel.inference_service import (
+    RemoteActClient,
+    act_request_crc,
+)
+from r2d2_tpu.utils.store import ParamStore
+
+A = 4
+
+
+def make_fake_env(cfg, seed):
+    """Module-level (picklable) factory for the spawn children."""
+    return FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                        seed=seed, episode_len=32)
+
+
+def _serve_cfg(**kw):
+    base = dict(num_actors=2, actor_transport="process",
+                actor_inference="serve")
+    base.update(kw)
+    return make_test_config(**base)
+
+
+def _long_episode_envs(cfg, n):
+    return [FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                         seed=i, episode_len=500) for i in range(n)]
+
+
+def _drive_serve(svc, actor, steps):
+    """Run ``actor`` in a thread while pumping the service from this one
+    (the in-process stand-in for the fabric's ``inference_serve`` loop)."""
+    done = threading.Event()
+    err = []
+
+    def run():
+        try:
+            actor.run(max_steps=steps)
+        except BaseException as e:  # surface, don't hang the test
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.time() + 120
+    while not done.is_set() and time.time() < deadline:
+        svc.serve_once(idle_sleep=0.0)
+    t.join(10)
+    assert done.is_set(), "remote actor never finished"
+    if err:
+        raise err[0]
+
+
+# ----------------------------------------------------------------- parity
+
+def test_serve_mode_blocks_bit_exact_vs_local():
+    """The acceptance invariant of the whole design: a VectorActor acting
+    through the RemoteActClient → InferenceService path must produce the
+    EXACT block stream (obs, priorities, stored hidden, episode rewards)
+    the local act fn produces — including the episode-step-cap bootstrap,
+    which serve mode answers with a no-commit ``peek`` so server-resident
+    hidden never double-advances.  At quiescence the server hidden
+    mirrors the actor's own recorded copy bit-exact."""
+    cfg = _serve_cfg(max_episode_steps=20)   # caps at 20/40: peeks fire
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+
+    got_local, got_serve = [], []
+    a1 = VectorActor(cfg, _long_episode_envs(cfg, 2), [0.4, 0.3],
+                     make_act_fn(cfg, net), ParamStore(params),
+                     sink=lambda b, p, e: got_local.append((b, p.copy(), e)),
+                     rng=np.random.default_rng(5))
+    a1.run(max_steps=57)   # mid-episode finish: no cap on the last step
+
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    svc = plane.service
+    assert svc is not None
+    svc.start(ParamStore(params))
+    ch = svc.make_channel(0)
+    client = RemoteActClient(cfg, A, 2, ch.producer_info(),
+                             mp.get_context("spawn").Event())
+    a2 = VectorActor(cfg, _long_episode_envs(cfg, 2), [0.4, 0.3], client,
+                     ParamStore(),   # empty: serve mode needs no weights
+                     sink=lambda b, p, e: got_serve.append((b, p.copy(), e)),
+                     rng=np.random.default_rng(5))
+    try:
+        _drive_serve(svc, a2, steps=57)
+
+        assert len(got_local) == len(got_serve) > 0
+        for (b1, p1, e1), (b2, p2, e2) in zip(got_local, got_serve):
+            for f in ("obs", "last_action", "last_reward", "action",
+                      "n_step_reward", "n_step_gamma", "hidden",
+                      "burn_in_steps", "learning_steps", "forward_steps"):
+                np.testing.assert_array_equal(getattr(b1, f),
+                                              getattr(b2, f), err_msg=f)
+            np.testing.assert_array_equal(p1, p2)
+            assert e1 == e2
+        # the cap fired → the bootstrap ran as peeks, never as commits
+        assert svc.peeks > 0
+        # server-resident hidden is the actor's own recorded state
+        np.testing.assert_array_equal(a1.hidden, a2.hidden)
+        assert not client._pending_resets
+        np.testing.assert_array_equal(svc.hidden, a2.hidden)
+        h = svc.health()
+        assert h["batches"] > 0 and h["mean_batch_lanes"] == 2.0
+    finally:
+        client.close()
+        svc.close()
+
+
+def test_serve_request_crc_detects_garbled_slab():
+    """A garbled act request (chaos, torn write) must be detected by the
+    CRC32 integrity word and COUNTED — but still served: dropping the
+    reply would wedge the lockstep fleet forever, and the replay ring is
+    independently protected by the block channel's own CRC."""
+    cfg = _serve_cfg()
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    svc = plane.service
+    svc.start(ParamStore(params))
+    ch = svc.make_channel(0)
+    try:
+        v = ch.views
+        rng = np.random.default_rng(0)
+        v["obs"][:] = rng.integers(0, 256, v["obs"].shape)
+        v["last_action"][:] = 0.0
+        v["last_reward"][:] = 0.0
+        v["reset_mask"][:] = 1
+        v["req_crc"][0] = act_request_crc(v, 1, True)
+        v["obs"][0, 0] ^= 0xFF   # garble AFTER the CRC landed
+        ch.req_q.put((1, 1))
+        deadline = time.time() + 30
+        while svc.batches == 0 and time.time() < deadline:
+            svc.serve_once(idle_sleep=0.0)
+        assert svc.requests_corrupt == 1
+        assert svc.health()["requests_corrupt"] == 1
+        assert ch.rsp_q.get(timeout=10) == 1   # still answered
+    finally:
+        svc.close()
+
+
+def test_serve_respawn_and_restore_hidden_lifecycle():
+    """Shard-level hidden lifecycle without subprocesses: reset_shard
+    zeroes exactly one fleet's lanes, load_shard_hidden restores a
+    snapshot bit-exact, and a geometry mismatch degrades to zeros."""
+    cfg = _serve_cfg(num_actors=4, actor_fleets=2)
+    plane = ProcessFleetPlane(cfg, A, make_fake_env,
+                              [0.4, 0.3, 0.2, 0.1])
+    svc = plane.service
+    rng = np.random.default_rng(3)
+    svc.hidden[:] = rng.normal(size=svc.hidden.shape).astype(np.float32)
+    before = svc.hidden.copy()
+
+    svc.reset_shard(0)
+    np.testing.assert_array_equal(svc.hidden[:2], 0.0)
+    np.testing.assert_array_equal(svc.hidden[2:], before[2:])  # untouched
+
+    snap_hidden = rng.normal(size=(2, 2, cfg.lstm_layers, cfg.hidden_dim)
+                             ).astype(np.float32)
+    svc.load_shard_hidden(0, snap_hidden)
+    np.testing.assert_array_equal(svc.hidden[:2], snap_hidden)
+
+    svc.load_shard_hidden(1, np.zeros((3, 2, 1, 1), np.float32))  # mismatch
+    np.testing.assert_array_equal(svc.hidden[2:], 0.0)
+    np.testing.assert_array_equal(svc.hidden[:2], snap_hidden)
+
+
+# ------------------------------------------------------------ weight pump
+
+def test_pump_payload_pickled_once_and_decodes():
+    """The bugfix satellite: one ParamStore version must be pickled ONCE
+    per pump, with every fleet queue receiving the SAME bytes blob (the
+    old path re-serialised the full host tree per fleet per version)."""
+    import queue
+
+    cfg = make_test_config(num_actors=2, actor_fleets=2,
+                           actor_transport="process")
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    plane.param_store = ParamStore(params)
+    plane.weight_queues = [queue.Queue(), queue.Queue()]
+
+    assert plane.pump_params_once()
+    b0 = plane.weight_queues[0].get_nowait()
+    b1 = plane.weight_queues[1].get_nowait()
+    assert isinstance(b0, bytes)
+    assert b0 is b1, "pump must share one pickle across the fleet queues"
+
+    version, decoded = _decode_pump(b0)
+    assert version == 1
+    host = jax.device_get(params)
+    for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(decoded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # same version again: no re-pump
+    assert not plane.pump_params_once()
+
+
+def test_param_pump_bf16_roundtrip_and_action_parity():
+    """QuaRL satellite: bf16-on-the-wire pumping must (a) narrow every
+    f32 leaf on the wire (≈half the pickle bytes), (b) decode back to
+    float32 at the original shapes, and (c) leave greedy actions on a
+    fixed batch in agreement with the f32 params within tolerance."""
+    import ml_dtypes
+
+    cfg = make_test_config(num_actors=2, actor_transport="process",
+                           param_pump_dtype="bfloat16")
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    plane.param_store = ParamStore(params)
+
+    host, version = plane._snapshot_params()
+    f32_leaves = [x for x in jax.tree.leaves(jax.device_get(params))
+                  if x.dtype == np.float32]
+    wire_leaves = [x for x in jax.tree.leaves(host)
+                   if x.dtype == ml_dtypes.bfloat16]
+    assert len(wire_leaves) == len(f32_leaves) > 0
+
+    blob = plane._encode_pump(version, host)
+    plane32 = ProcessFleetPlane(cfg.replace(param_pump_dtype="float32"),
+                                A, make_fake_env, [0.4, 0.3])
+    plane32.param_store = ParamStore(params)
+    host32, _ = plane32._snapshot_params()
+    blob32 = plane32._encode_pump(version, host32)
+    assert len(blob) < 0.6 * len(blob32), \
+        f"bf16 pump should ~halve the payload ({len(blob)} vs {len(blob32)})"
+
+    _, decoded = _decode_pump(blob)
+    ref = jax.device_get(params)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(decoded)):
+        assert np.asarray(b).dtype == np.asarray(a).dtype
+        assert np.asarray(b).shape == np.asarray(a).shape
+
+    act = make_act_fn(cfg, net)
+    rng = np.random.default_rng(7)
+    obs = rng.integers(0, 256, (8, *cfg.stored_obs_shape)).astype(np.uint8)
+    la = np.zeros((8, A), np.float32)
+    lr = np.zeros(8, np.float32)
+    hidden = rng.normal(size=(8, 2, cfg.lstm_layers, cfg.hidden_dim)
+                        ).astype(np.float32) * 0.1
+    q1, _ = act(params, obs, la, lr, hidden)
+    q2, _ = act(decoded, obs, la, lr, hidden)
+    q1, q2 = np.asarray(q1), np.asarray(q2)
+    np.testing.assert_allclose(q1, q2, atol=5e-2, rtol=5e-2)
+    np.testing.assert_array_equal(q1.argmax(axis=1), q2.argmax(axis=1))
+
+
+# ------------------------------------------------------------- validation
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError, match="actor_transport='process'"):
+        make_test_config(actor_inference="serve")   # thread transport
+    with pytest.raises(ValueError, match="actor_inference"):
+        make_test_config(actor_inference="remote")
+    with pytest.raises(ValueError, match="param_pump_dtype"):
+        make_test_config(param_pump_dtype="float16")
+    with pytest.raises(ValueError, match="inference_batch_window"):
+        make_test_config(inference_batch_window=-1.0)
+    cfg = make_test_config(actor_transport="process",
+                           actor_inference="serve")
+    assert cfg.actor_inference == "serve"
+
+
+def test_cli_actor_inference_flag():
+    from r2d2_tpu.cli import build_config, main
+
+    class Args:
+        preset = "test"
+        game = None
+        actors = None
+        seed = None
+        training_steps = None
+        overrides = None
+        actor_transport = "process"
+        actor_inference = "serve"
+
+    cfg = build_config(Args())
+    assert cfg.actor_inference == "serve"
+    assert cfg.actor_transport == "process"
+    # serve without the process transport must fail loudly at the parser
+    with pytest.raises(SystemExit):
+        main(["train", "--preset", "test", "--game", "Fake",
+              "--actor-inference", "serve", "--sync"])
+
+
+# ------------------------------------------------------------- end-to-end
+
+@pytest.mark.timeout(600)
+def test_train_serve_mode_end_to_end():
+    """The acceptance path: ``train()`` with two serve-mode fleet
+    subprocesses on CPU — every act is an RPC to the InferenceService
+    fabric thread, blocks flow over the block channel, the learner
+    trains, and the cross-fleet batch size is observable in the fleet
+    health stats.  Kept tier-1 as the serve transport's living proof."""
+    from r2d2_tpu.train import train
+
+    cfg = make_test_config(game_name="Fake", num_actors=4, actor_fleets=2,
+                           actor_transport="process",
+                           actor_inference="serve", training_steps=6,
+                           log_interval=0.2)
+    m = train(cfg, env_factory=make_fake_env, max_wall_seconds=240,
+              verbose=False)
+    assert m["num_updates"] >= cfg.training_steps
+    assert np.isfinite(m["mean_loss"])
+    assert not m["fabric_failed"]
+    fleet = m["fleet_health"]
+    assert fleet["fleets"] == 2 and fleet["alive"] == 0
+    assert all(c > 0 for c in fleet["blocks_per_fleet"])
+    svc = fleet["service"]
+    assert svc["batches"] > 0
+    # cross-fleet batching genuinely happened (window coalesces the two
+    # 2-lane fleets; lone stragglers keep the mean below the full 4)
+    assert svc["mean_batch_lanes"] > 2.0
+    assert svc["lanes_served"] >= fleet["frames_ingested"]
+    # serve-loop spans landed in the tracer (batch assembly/act/scatter)
+    spans = m["trace"]
+    for stage in ("serve.assemble", "serve.act", "serve.scatter"):
+        assert spans[f"span.{stage}.count"] > 0

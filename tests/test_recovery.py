@@ -425,3 +425,96 @@ def test_process_fleet_snapshot_handshake_and_restore(tmp_path):
         assert got2, "restored fleet produced no blocks"
     finally:
         plane2.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_serve_snapshot_restores_server_hidden_bit_exact(tmp_path):
+    """Serve-mode recovery (ISSUE 3): the shutdown snapshot handshake
+    must capture the server-resident recurrent state (mirrored in each
+    fleet's actor snapshot), and a new plane armed with those snapshots
+    must restore its InferenceService hidden lanes BIT-EXACT at spawn —
+    before a single request is served.  slow: two rounds of subprocess
+    spawns."""
+    import time
+
+    from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane
+    from r2d2_tpu.utils.store import ParamStore
+    from r2d2_tpu.models.network import create_network, init_params
+    from test_actor_procs import make_fake_env
+
+    cfg = make_test_config(game_name="Fake", num_actors=2, actor_fleets=1,
+                           actor_transport="process",
+                           actor_inference="serve")
+    net = create_network(cfg, A)
+    store = ParamStore(init_params(cfg, net, jax.random.PRNGKey(0)))
+
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    got = []
+    try:
+        plane.start(store)
+        t0 = time.time()
+        while len(got) < 2 and time.time() < t0 + 120:
+            plane.service.serve_once(idle_sleep=0.0)
+            plane.ingest_once(lambda b, p, e: got.append(1), timeout=0.01)
+        assert len(got) >= 2
+    finally:
+        snaps = plane.shutdown(snapshot=True)
+    assert snaps is not None and snaps[0] is not None
+    snap_hidden = np.asarray(snaps[0]["agent"]["hidden"], np.float32)
+    assert np.any(snap_hidden != 0)
+
+    plane2 = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    plane2.set_restore_snapshots(snaps)
+    got2 = []
+    try:
+        plane2.start(store)
+        # restored BEFORE any request: the spawn path loads the shard
+        np.testing.assert_array_equal(plane2.service.hidden, snap_hidden)
+        t0 = time.time()
+        while len(got2) < 1 and time.time() < t0 + 120:
+            plane2.service.serve_once(idle_sleep=0.0)
+            plane2.ingest_once(lambda b, p, e: got2.append(1), timeout=0.01)
+        assert got2, "restored serve fleet produced no blocks"
+    finally:
+        plane2.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_sigterm_resume_serve_mode_end_to_end(tmp_path):
+    """SIGTERM a live serve-mode training run (process fleets + central
+    InferenceService); restart with resume=True: the full-state snapshot
+    (learner, replay ring, actor/server state) must come back warm and
+    training must continue.  slow: two rounds of fleet spawns."""
+    from test_actor_procs import make_fake_env
+
+    ck_dir = str(tmp_path / "ck")
+    cfg = make_test_config(game_name="Fake", num_actors=2, actor_fleets=2,
+                           actor_transport="process",
+                           actor_inference="serve",
+                           training_steps=100000, log_interval=0.2,
+                           save_interval=10 ** 8)
+
+    def sink(entry):
+        if entry["training_steps"] >= 6:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    m = train(cfg, env_factory=make_fake_env, checkpoint_dir=ck_dir,
+              verbose=False, log_sink=sink, max_wall_seconds=300)
+    assert 0 < m["num_updates"] < 100000
+    assert not m["fabric_failed"]
+
+    ck = Checkpointer(ck_dir)
+    assert ck.latest_step() is not None and ck.replay_steps()
+    _, _, actor_snaps = ck.restore_replay()
+    assert actor_snaps is not None
+    assert sum(s is not None for s in actor_snaps) >= 1
+
+    m2 = train(cfg.replace(training_steps=m["num_updates"] + 3),
+               env_factory=make_fake_env, checkpoint_dir=ck_dir,
+               resume=True, verbose=False, max_wall_seconds=300)
+    assert m2["restored_replay"]
+    assert m2["num_updates"] >= m["num_updates"] + 3
+    assert not m2["fabric_failed"]
+    assert np.isfinite(m2["mean_loss"])

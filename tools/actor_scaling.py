@@ -1,19 +1,24 @@
 """Actor-plane scaling measurement: frames/s vs transport / workers / fleets.
 
-Answers VERDICT r3 item 6 (host-parallelism slopes) and the r6 tentpole's
-go/no-go: does the PROCESS-fleet transport (parallel/actor_procs, the
-reference's N-process topology over a shared-memory block channel) beat
-the thread transport per core on this host?  Sweeps the SAME measurement
-as the headline bench — bench._actor_plane_bench for threads,
-bench._actor_plane_bench_process for subprocess fleets — so nothing is
-reimplemented to drift.
+Answers VERDICT r3 item 6 (host-parallelism slopes), the r6 tentpole's
+thread-vs-process go/no-go, and the r7 tentpole's go/no-go: does the
+CENTRALIZED inference service (``actor_inference="serve"``,
+parallel/inference_service.py — fleets RPC one trainer-side act server
+that batches across all of them) hold parity with per-fleet local CPU
+inference on the same lane count?  On an accelerator host the serve path
+additionally moves acting onto the device; on CPU it trades F small
+per-fleet batches for one F×-larger central batch — parity here is the
+floor, not the win.  Sweeps the SAME measurement as the headline bench —
+bench._actor_plane_bench for threads, bench._actor_plane_bench_process
+for subprocess fleets (local and serve) — so nothing is reimplemented to
+drift.
 
-Default run is CPU-pinned and writes the scaling table to
-artifacts/r06/ACTOR_SCALING_r06.json.  ``--device`` leaves the default
-backend alone and measures ONLY the act_device cells (CPU twin vs
-on-device acting), merging them into the existing artifact instead of
-re-measuring — and overwriting — the CPU-pinned table with a different
-backend active.
+Default run is CPU-pinned and writes the r7 local-vs-serve table to
+artifacts/r07/ACTOR_SCALING_r07.json plus a rendered
+docs/perf/ACTOR_SCALING_r07.md.  ``--device`` leaves the default backend
+alone and measures ONLY the act_device cells (CPU twin vs on-device
+acting), merging them into the existing artifact instead of re-measuring
+— and overwriting — the CPU-pinned table with a different backend active.
 """
 import json
 import os
@@ -38,7 +43,8 @@ from r2d2_tpu.bench import (  # noqa: E402
 )
 
 ITERS = 300
-PATH = "artifacts/r06/ACTOR_SCALING_r06.json"
+PATH = "artifacts/r07/ACTOR_SCALING_r07.json"
+DOC = "docs/perf/ACTOR_SCALING_r07.md"
 
 
 def cell(env_workers: int, fleets: int, act_device: str = "auto") -> dict:
@@ -51,15 +57,68 @@ def cell(env_workers: int, fleets: int, act_device: str = "auto") -> dict:
                 backend=jax.default_backend(), frames_per_sec=round(fps, 1))
 
 
-def pcell(fleets: int, env_workers: int = 0) -> dict:
+def pcell(fleets: int, env_workers: int = 0,
+          inference: str = "local") -> dict:
     # burst-aligned measurement (see _actor_plane_bench_process): exact
     # over one full block-cut cycle per fleet, immune to burst phase
-    fps = _actor_plane_bench_process(fleets=fleets, env_workers=env_workers)
-    print(f"transport=process env_workers={env_workers} fleets={fleets}: "
+    fps = _actor_plane_bench_process(fleets=fleets, env_workers=env_workers,
+                                     actor_inference=inference)
+    print(f"transport=process inference={inference} "
+          f"env_workers={env_workers} fleets={fleets}: "
           f"{fps:,.0f} frames/s", flush=True)
-    return dict(transport="process", env_workers=env_workers,
-                actor_fleets=fleets, act_device="cpu",
+    return dict(transport="process", actor_inference=inference,
+                env_workers=env_workers, actor_fleets=fleets,
+                act_device="cpu" if inference == "local" else "serve",
                 backend=jax.default_backend(), frames_per_sec=round(fps, 1))
+
+
+def render_doc(data: dict) -> str:
+    lines = [
+        "# Actor-plane scaling — r07: local vs centralized (serve) "
+        "inference",
+        "",
+        f"Host: {data['host_cpus']} CPUs, backend cells below; "
+        f"{data['lanes']} lanes, pong-scale network.",
+        "Process cells are burst-aligned (one full block-cut cycle per "
+        "fleet, phase-exact);",
+        "`serve` cells route every env step through the trainer's "
+        "InferenceService",
+        "(one cross-fleet batched act per step, server-resident LSTM "
+        "state).",
+        "",
+        "| transport | inference | fleets | env_workers | frames/s |",
+        "|---|---|---|---|---|",
+    ]
+    for r in data["results"]:
+        lines.append(
+            f"| {r['transport']} | {r.get('actor_inference', '-')} "
+            f"| {r['actor_fleets']} | {r['env_workers']} "
+            f"| {r['frames_per_sec']:,.0f} |")
+    by = {}
+    for r in data["results"]:
+        if r["transport"] == "process":
+            by[(r.get("actor_inference", "local"),
+                r["actor_fleets"])] = r["frames_per_sec"]
+    ratio_lines = []
+    for f in sorted({k[1] for k in by}):
+        if ("local", f) in by and ("serve", f) in by and by[("local", f)]:
+            ratio_lines.append(
+                f"- {f} fleet(s): serve/local = "
+                f"{by[('serve', f)] / by[('local', f)]:.2f}x")
+    if ratio_lines:
+        lines += ["", "## serve vs local (same lane count)", ""] + ratio_lines
+    lines += [
+        "",
+        "Reading: on a CPU-only host serve centralizes the same math into "
+        "one process, so",
+        "parity is the pass bar; the design's payoff (device-batched "
+        "acting, zero-staleness",
+        "weights, no per-fleet weight pump) lands when the service runs "
+        "on the learner's",
+        "accelerator (`--device` cells / a real TPU host).",
+        "",
+    ]
+    return "\n".join(lines)
 
 
 def main() -> None:
@@ -73,15 +132,20 @@ def main() -> None:
         # appended to the existing host table
         results = [cell(0, 1, "auto"), cell(0, 1, "default")]
     else:
-        # thread-vs-process slope on whatever cores exist: matched fleet
-        # counts on both transports, plus the env-worker knob inside one
-        # fleet for the thread side
-        results = ([cell(w, f) for w, f in [(0, 1), (2, 1), (0, 2), (0, 4)]]
-                   + [pcell(f) for f in (1, 2, 4)])
+        # the r07 question: local per-fleet CPU inference vs the
+        # centralized serve path, matched fleet counts, plus a thread
+        # baseline on the same lane count
+        results = ([cell(0, f) for f in (1, 2)]
+                   + [pcell(f, inference="local") for f in (1, 2, 4)]
+                   + [pcell(f, inference="serve") for f in (1, 2, 4)])
     prior["results"] = prior.get("results", []) + results
     with open(PATH, "w") as f:
         json.dump(prior, f, indent=1)
     print(f"→ {PATH}", flush=True)
+    os.makedirs(os.path.dirname(DOC), exist_ok=True)
+    with open(DOC, "w") as f:
+        f.write(render_doc(prior))
+    print(f"→ {DOC}", flush=True)
 
 
 if __name__ == "__main__":

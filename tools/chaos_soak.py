@@ -10,11 +10,14 @@ resumes from the full-state snapshot and VERIFIES the warm restart:
 replay mass/size match the snapshot meta, the learner state restores,
 and training keeps advancing.  Exit code 1 on any violated invariant.
 
-Run:  python tools/chaos_soak.py [minutes] [--process] [--out OUT.json]
+Run:  python tools/chaos_soak.py [minutes] [--process] [--serve]
+                                 [--out OUT.json]
 
 ``--process`` soaks the subprocess actor plane (enables the kill_fleet /
-garble_block sites); default soaks the thread transport (freeze +
-truncate sites only).
+garble_block sites); ``--serve`` additionally routes acting through the
+centralized InferenceService (implies --process — the kill_fleet site
+then also drills the respawn path's server-hidden zeroing).  Default
+soaks the thread transport (freeze + truncate sites only).
 """
 import json
 import os
@@ -25,7 +28,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _argv = sys.argv[1:]
-PROCESS = "--process" in _argv
+SERVE = "--serve" in _argv
+PROCESS = "--process" in _argv or SERVE
 OUT = None
 if "--out" in _argv:
     i = _argv.index("--out")
@@ -61,7 +65,8 @@ def main() -> int:
     if PROCESS:
         chaos += ";kill_fleet:every=120;garble_block:p=0.005"
         transport = dict(actor_transport="process", num_actors=2,
-                         actor_fleets=2)
+                         actor_fleets=2,
+                         actor_inference="serve" if SERVE else "local")
     cfg = test_config(
         game_name="Fake", training_steps=10 ** 9, log_interval=1.0,
         save_interval=200, keep_checkpoints=3, chaos_spec=chaos,
