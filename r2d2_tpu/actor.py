@@ -148,12 +148,16 @@ def make_act_fn(cfg: Config, net: R2D2Network):
     act_net = (create_network(cfg.replace(**twin), net.action_dim)
                if twin else net)
 
-    @jax.jit
     def act(params, obs, last_action, last_reward, hidden):
         return act_net.apply(params, obs, last_action, last_reward, hidden,
                              method=R2D2Network.act)
 
-    return act
+    # retrace-guarded (utils/trace.py): one act-fn instance serves one
+    # fixed lane batch, so a second trace means shape/dtype drift in the
+    # hot loop — the e2e tests assert the budget holds
+    from r2d2_tpu.utils.trace import RETRACES
+
+    return jax.jit(RETRACES.wrap("actor.act", act))
 
 
 class VectorActor:

@@ -1,0 +1,67 @@
+"""graftlint — repo-native static analysis (``python -m r2d2_tpu.analysis``).
+
+Rule families (see docs/ANALYSIS.md for the full reference):
+
+- ``jit-purity``        host effects inside jit-traced code
+- ``config-integrity``  cfg.X resolution + field liveness/docs
+- ``thread-discipline`` Supervisor-managed threads, locked shared writes
+- ``wire-format``       shm slot layout / CRC single-sourced in replay/block
+
+Importing this package registers every rule.  The analyzer itself is
+pure stdlib ``ast``: the ``r2d2_tpu`` package root does pull in jax at
+import time (a few seconds), but the analyzer never calls a jax API or
+initializes a device backend — so it is safe to run on a host whose
+accelerator claim is wedged (backend init, not ``import jax``, is what
+hangs there), and the analysis pass itself finishes in milliseconds.
+"""
+from r2d2_tpu.analysis.core import (  # noqa: F401
+    RULES,
+    ConfigSchema,
+    Context,
+    Finding,
+    Report,
+    analyze_source,
+    rule,
+    run_analysis,
+)
+from r2d2_tpu.analysis import (  # noqa: F401  (import = rule registration)
+    config_integrity,
+    jit_purity,
+    thread_discipline,
+    wire_format,
+)
+
+
+def main(argv=None) -> int:
+    """Console entry (pyproject ``r2d2-lint``); same driver as
+    ``python -m r2d2_tpu.analysis``."""
+    from r2d2_tpu.analysis.__main__ import main as _main
+
+    return _main(argv)
+
+
+def preflight(root, paths=("r2d2_tpu", "tools")) -> None:
+    """Shared fail-fast gate for the long-running tools (tools/soak.py,
+    tools/chaos_soak.py): run the analyzer CLI as a bounded subprocess
+    over ``paths`` and ``sys.exit`` with its report if the tree is dirty
+    — a multi-minute soak must not burn its wall budget proving what the
+    analyzer knows up front (misspelled cfg fields, unsupervised
+    threads, restated shm CRC literals — docs/ANALYSIS.md)."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "r2d2_tpu.analysis", *paths, "--json"],
+            cwd=root, capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        # genuinely bounded: a wedged interpreter start-up (broken jax
+        # install, hung filesystem) must fail the preflight, not park the
+        # soak forever before its own watchdogs exist
+        sys.exit("graftlint preflight timed out after 120 s — the "
+                 "analyzer child never finished; fix the host before "
+                 "burning a soak budget")
+    if proc.returncode != 0:
+        print(proc.stdout or proc.stderr, file=sys.stderr)
+        sys.exit("graftlint preflight failed — fix the findings above "
+                 "before burning a soak budget")

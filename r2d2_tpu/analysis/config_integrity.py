@@ -1,0 +1,128 @@
+"""config-integrity: every ``cfg.X`` resolves, every field earns its keep.
+
+The frozen ``Config`` dataclass is referenced as bare attribute strings
+(~50 fields across the tree); a typo'd ``cfg.leraning_steps`` is a
+silent ``AttributeError`` at runtime — or worse, a ``getattr`` default
+that quietly disables a feature.  Three checks:
+
+1. **resolution** — every attribute access on a config-shaped receiver
+   (a name that is or ends with ``cfg``/``config``, or ``*.cfg``), every
+   ``getattr(cfg, "X")`` string, and every keyword of ``cfg.replace(...)``
+   must name a real Config field / property / method.
+2. **liveness** — every declared field must be referenced somewhere in
+   the analyzed tree outside ``config.py`` itself (dead knobs rot).
+3. **mention** — every field must appear (word-boundary) in the CLI
+   module, README, or a ``docs/*.md`` file, so operators can discover it
+   (the knob table in docs/OPERATIONS.md is the canonical home).
+
+Checks 2 and 3 only run when the analyzed set includes the module that
+defines ``Config`` (so fixture snippets exercise check 1 alone).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from r2d2_tpu.analysis.core import Context, Finding, rule
+
+RULE = "config-integrity"
+
+# attribute names every dataclass instance has; never worth flagging
+_DATACLASS_ATTRS = {"replace", "__post_init__", "__dataclass_fields__"}
+
+
+def _is_config_receiver(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        n = node.id.lower()
+        return n in ("cfg", "config") or n.endswith("cfg") \
+            or n.endswith("_config")
+    if isinstance(node, ast.Attribute):
+        a = node.attr.lower()
+        return a == "cfg" or a.endswith("_cfg")
+    return False
+
+
+def _config_attr_uses(tree: ast.AST) -> List[Tuple[str, int, str]]:
+    """(field, line, kind) for every config-shaped reference."""
+    uses: List[Tuple[str, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and _is_config_receiver(
+                node.value):
+            uses.append((node.attr, node.lineno, "attribute"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id == "getattr"
+                    and len(node.args) >= 2
+                    and _is_config_receiver(node.args[0])
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                uses.append((node.args[1].value, node.lineno, "getattr"))
+            elif (isinstance(f, ast.Attribute) and f.attr == "replace"
+                    and _is_config_receiver(f.value)):
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        uses.append((kw.arg, node.lineno, "replace kwarg"))
+    return uses
+
+
+@rule(RULE, "cfg.X references resolve to real Config fields; every field "
+            "is referenced and documented")
+def check_config_integrity(ctx: Context) -> List[Finding]:
+    schema = ctx.config_schema
+    if schema is None:
+        return []
+    findings: List[Finding] = []
+    valid = schema.valid_attrs | _DATACLASS_ATTRS
+    referenced: Set[str] = set()
+    # loose reference census for the liveness check: ANY attribute access
+    # or string literal naming a field counts (receivers are heuristic;
+    # liveness must not produce false "dead field" findings because a
+    # config travelled under an unusual name)
+    loose_attr: Dict[str, int] = {}
+
+    analyzed_has_config = False
+    for mod in ctx.modules:
+        is_config_mod = (mod.rel == schema.module_rel)
+        analyzed_has_config = analyzed_has_config or is_config_mod
+        for name, line, kind in _config_attr_uses(mod.tree):
+            if not is_config_mod:
+                referenced.add(name)
+            if name.startswith("__") or name in valid:
+                continue
+            findings.append(Finding(
+                RULE, mod.rel, line,
+                f"{kind} {name!r} does not resolve to a Config "
+                "field/property (typo or removed knob?)"))
+        if is_config_mod:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                loose_attr[node.attr] = loose_attr.get(node.attr, 0) + 1
+            elif (isinstance(node, ast.Constant)
+                  and isinstance(node.value, str)
+                  and node.value in schema.fields):
+                referenced.add(node.value)
+            elif isinstance(node, ast.keyword) and node.arg is not None:
+                # preset/test kwargs (test_config(burn_in_steps=...))
+                # count as live uses of the knob
+                if node.arg in schema.fields:
+                    referenced.add(node.arg)
+
+    if not analyzed_has_config:
+        return findings
+
+    docs = "\n".join(ctx.doc_texts())
+    for field in sorted(schema.fields):
+        line = schema.field_lines.get(field, 1)
+        if field not in referenced and loose_attr.get(field, 0) == 0:
+            findings.append(Finding(
+                RULE, schema.module_rel, line,
+                f"Config field {field!r} is never referenced outside "
+                "config.py (dead knob — delete it or wire it up)"))
+        if not re.search(rf"\b{re.escape(field)}\b", docs):
+            findings.append(Finding(
+                RULE, schema.module_rel, line,
+                f"Config field {field!r} has no CLI/docs mention (add it "
+                "to the docs/OPERATIONS.md knob table)"))
+    return findings

@@ -1,0 +1,221 @@
+"""jit-purity: host effects inside jit-traced code.
+
+A function traced by ``jax.jit`` / ``shard_map`` runs its Python body
+ONCE per compilation; host-side effects inside it are frozen into the
+graph (Podracer's compile/step-boundary discipline, PAPERS.md):
+
+- ``time.*`` calls bake the trace-time clock into every step;
+- Python / ``np.random`` RNG bakes one draw in forever (device RNG is
+  ``jax.random``);
+- ``.item()`` / ``float()`` / ``int()`` / ``jax.device_get`` on tracers
+  force a host transfer (or raise) — either way the hot loop stalls;
+- mutable default arguments alias one object across traces.
+
+Scope: intra-module, best-effort.  Roots are functions handed to
+``jax.jit`` / ``pjit`` / ``shard_map`` (as decorators, direct calls,
+``functools.partial`` wrappings, retrace-guard ``.wrap(...)`` wrappings,
+or factory calls whose returned inner function the jit wraps); the rule
+then follows name references to other functions *in the same module*.
+Cross-module callees (e.g. ``net.apply``) are covered when their own
+module has jit sites, not transitively — the rule is a tripwire, not a
+type system.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from r2d2_tpu.analysis.core import Context, Finding, dotted_name, rule
+
+RULE = "jit-purity"
+
+_JIT_NAMES = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_HOST_RNG_PREFIXES = ("np.random.", "numpy.random.", "random.")
+_MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _ModuleIndex:
+    """Named function defs + factory returns for one module."""
+
+    def __init__(self, tree: ast.AST):
+        self.defs: Dict[str, List[ast.AST]] = {}
+        # simple `name = factory(...)` assignments, for resolving
+        # `jax.jit(fn)` where fn was produced by a local factory
+        self.assigned_calls: Dict[str, ast.Call] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, _FuncNode):
+                self.defs.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and isinstance(node.value,
+                                                          ast.Call):
+                    self.assigned_calls[t.id] = node.value
+
+    def returned_functions(self, func: ast.AST) -> List[ast.AST]:
+        """Function nodes a factory returns (``return inner`` /
+        ``return jax.jit(inner)`` / ``return lambda ...``)."""
+        out: List[ast.AST] = []
+        inner = {n.name: n for n in ast.walk(func)
+                 if isinstance(n, _FuncNode) and n is not func}
+        for node in ast.walk(func):
+            if not (isinstance(node, ast.Return) and node.value is not None):
+                continue
+            v = node.value
+            if isinstance(v, ast.Name) and v.id in inner:
+                out.append(inner[v.id])
+            elif isinstance(v, ast.Lambda):
+                out.append(v)
+            elif isinstance(v, ast.Call):
+                d = dotted_name(v.func)
+                if d in _JIT_NAMES and v.args:
+                    out.extend(self._resolve_seed(v.args[0]))
+        return out
+
+    def _resolve_seed(self, node) -> List[ast.AST]:
+        """Function nodes a jit-call argument ultimately names."""
+        if isinstance(node, ast.Lambda):
+            return [node]
+        if isinstance(node, ast.Name):
+            if node.id in self.defs:
+                return list(self.defs[node.id])
+            call = self.assigned_calls.get(node.id)
+            if call is not None:
+                return self._resolve_seed(call)
+            return []
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            if d in _PARTIAL_NAMES and node.args:
+                return self._resolve_seed(node.args[0])
+            if d.endswith(".wrap") or d == "retrace_wrap":
+                # utils.trace.RETRACES.wrap("name", fn, ...): the traced
+                # function is the first non-string argument
+                out: List[ast.AST] = []
+                for a in node.args:
+                    if isinstance(a, ast.Constant):
+                        continue
+                    out.extend(self._resolve_seed(a))
+                return out
+            # factory call: the jitted function is what the factory returns
+            if isinstance(node.func, ast.Name):
+                out = []
+                for f in self.defs.get(node.func.id, []):
+                    out.extend(self.returned_functions(f))
+                return out
+        return []
+
+    def roots(self, tree: ast.AST) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d in _JIT_NAMES and node.args:
+                    out.extend(self._resolve_seed(node.args[0]))
+            elif isinstance(node, _FuncNode):
+                for dec in node.decorator_list:
+                    d = dotted_name(dec)
+                    if d in _JIT_NAMES:
+                        out.append(node)
+                    elif isinstance(dec, ast.Call):
+                        dc = dotted_name(dec.func)
+                        if dc in _JIT_NAMES:
+                            out.append(node)
+                        elif (dc in _PARTIAL_NAMES and dec.args
+                              and dotted_name(dec.args[0]) in _JIT_NAMES):
+                            out.append(node)
+        return out
+
+
+def _reachable(index: _ModuleIndex, roots: List[ast.AST]) -> List[ast.AST]:
+    seen: Set[int] = set()
+    order: List[ast.AST] = []
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        order.append(fn)
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in index.defs):
+                work.extend(index.defs[node.id])
+    return order
+
+
+def _fn_label(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+def _scan_function(rel: str, fn: ast.AST, out: List[Finding],
+                   seen: Set[tuple]) -> None:
+    label = _fn_label(fn)
+
+    def emit(line: int, msg: str) -> None:
+        key = (line, msg)
+        if key not in seen:
+            seen.add(key)
+            out.append(Finding(RULE, rel, line, msg))
+
+    for node in ast.walk(fn):
+        if isinstance(node, _FuncNode + (ast.Lambda,)):
+            args = node.args
+            for default in list(args.defaults) + [
+                    d for d in args.kw_defaults if d is not None]:
+                if isinstance(default, _MUTABLE_DEFAULTS):
+                    emit(default.lineno,
+                         f"mutable default argument in jit-reachable "
+                         f"function {_fn_label(node)!r} (one object is "
+                         "shared across every trace)")
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func) or ""
+        if d.startswith("time."):
+            emit(node.lineno,
+                 f"host clock call {d}() inside jit-reachable function "
+                 f"{label!r} (the trace freezes its value)")
+        elif d.startswith(_HOST_RNG_PREFIXES):
+            emit(node.lineno,
+                 f"host RNG call {d}() inside jit-reachable function "
+                 f"{label!r} (one draw is baked into the graph; use "
+                 "jax.random)")
+        elif d == "jax.device_get":
+            emit(node.lineno,
+                 f"jax.device_get inside jit-reachable function {label!r} "
+                 "(forces a host transfer per trace)")
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args
+              and not node.keywords):
+            emit(node.lineno,
+                 f".item() inside jit-reachable function {label!r} "
+                 "(host transfer; keep scalars on device)")
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in ("float", "int", "bool")
+              and len(node.args) == 1
+              and not isinstance(node.args[0], ast.Constant)):
+            emit(node.lineno,
+                 f"{node.func.id}() scalarization inside jit-reachable "
+                 f"function {label!r} (host transfer on a tracer; use "
+                 "jnp casts)")
+
+
+@rule(RULE, "no host clocks/RNG/transfers or mutable defaults in functions "
+            "reachable from jax.jit / shard_map call sites")
+def check_jit_purity(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        index = _ModuleIndex(mod.tree)
+        roots = index.roots(mod.tree)
+        if not roots:
+            continue
+        seen: Set[tuple] = set()
+        for fn in _reachable(index, roots):
+            _scan_function(mod.rel, fn, findings, seen)
+    return findings

@@ -1,0 +1,109 @@
+"""wire-format: the shm slot layout and CRC live in ONE module.
+
+Three modules speak the shared-memory wire format (``replay/block.py``
+defines it; ``parallel/actor_procs.py`` and
+``parallel/inference_service.py`` transport over it).  The CRC32
+convention — int64 header words, payload arrays in declared order, the
+32-bit mask, written LAST — is a torn-write detector only as long as the
+producer and verifier agree bit-for-bit; a restated literal in one of the
+transport modules is exactly the kind of drift that ships silently and
+corrupts recovery later.
+
+The rule fires in any module that imports ``multiprocessing
+.shared_memory`` (the shm-transport signature) **other than the wire
+-format module itself** when it:
+
+- calls ``zlib.crc32`` directly (use ``replay.block.payload_crc32``),
+- restates the 32-bit CRC mask literal ``0xFFFFFFFF``,
+- re-defines a wire-format function (``slot_layout`` / ``slot_views`` /
+  ``slot_crc`` / ``block_slot_spec`` / ``write_block`` / ``read_block``
+  / ``payload_crc32``) instead of importing it,
+- uses a wire-format name without importing it from
+  ``r2d2_tpu.replay.block``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from r2d2_tpu.analysis.core import Context, Finding, dotted_name, rule
+
+RULE = "wire-format"
+
+WIRE_MODULE = "r2d2_tpu.replay.block"
+WIRE_MODULE_SUFFIX = "replay/block.py"
+WIRE_NAMES = {"slot_layout", "slot_views", "slot_crc", "block_slot_spec",
+              "write_block", "read_block", "payload_crc32", "CRC_MASK"}
+CRC_MASK_VALUE = 0xFFFFFFFF
+
+
+def _uses_shared_memory(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.startswith("multiprocessing") for a in node.names):
+                # `import multiprocessing as mp` alone isn't shm; require
+                # the shared_memory submodule somewhere
+                if any(a.name == "multiprocessing.shared_memory"
+                       for a in node.names):
+                    return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "multiprocessing" and any(
+                    a.name == "shared_memory" for a in node.names):
+                return True
+            if node.module == "multiprocessing.shared_memory":
+                return True
+    return False
+
+
+def _block_imports(tree: ast.AST) -> Set[str]:
+    """Wire-format names imported from the canonical module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom)
+                and node.module == WIRE_MODULE):
+            out.update(a.asname or a.name for a in node.names)
+    return out
+
+
+@rule(RULE, "shm transport modules import the slot layout / CRC from "
+            "replay/block.py instead of restating literals")
+def check_wire_format(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if mod.rel.endswith(WIRE_MODULE_SUFFIX):
+            continue
+        if not _uses_shared_memory(mod.tree):
+            continue
+        imported = _block_imports(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d in ("zlib.crc32", "crc32"):
+                    findings.append(Finding(
+                        RULE, mod.rel, node.lineno,
+                        "direct zlib.crc32 in an shm transport module — "
+                        "compute integrity words via "
+                        "replay.block.payload_crc32 so producer and "
+                        "verifier can never drift"))
+            elif (isinstance(node, ast.Constant)
+                  and type(node.value) is int
+                  and node.value == CRC_MASK_VALUE):
+                findings.append(Finding(
+                    RULE, mod.rel, node.lineno,
+                    "restated CRC mask literal 0xFFFFFFFF — import the "
+                    "convention from replay.block (payload_crc32/CRC_MASK)"))
+            elif (isinstance(node, ast.FunctionDef)
+                  and node.name in WIRE_NAMES):
+                findings.append(Finding(
+                    RULE, mod.rel, node.lineno,
+                    f"wire-format function {node.name!r} re-defined here — "
+                    f"import it from {WIRE_MODULE}"))
+            elif (isinstance(node, ast.Name)
+                  and isinstance(node.ctx, ast.Load)
+                  and node.id in WIRE_NAMES
+                  and node.id not in imported):
+                findings.append(Finding(
+                    RULE, mod.rel, node.lineno,
+                    f"wire-format name {node.id!r} used without importing "
+                    f"it from {WIRE_MODULE}"))
+    return findings

@@ -171,7 +171,10 @@ def _actor_plane_bench(iterations: int = 400, num_lanes: int = 64,
                                   rng=np.random.default_rng(1 + f)))
     for a in actors:
         a.run(max_steps=20)  # warmup: compile act fn, prime pools
-    threads = [threading.Thread(target=a.run,
+    # bare Threads by design: these are bounded measurement workers, started
+    # and joined inside this one timed window — a Supervisor restart would
+    # silently rerun part of the workload and corrupt the timing
+    threads = [threading.Thread(target=a.run,  # graftlint: disable=thread-discipline -- bounded, joined below; a restart would corrupt the measurement
                                 kwargs=dict(max_steps=iterations))
                for a in actors[1:]]
     t0 = time.perf_counter()
@@ -240,14 +243,19 @@ def _actor_plane_bench_process(num_lanes: int = 64, fleets: int = 2,
     plane = ProcessFleetPlane(cfg, 4, _bench_env_factory, eps)
     F = plane.num_fleets
     serve_stop = threading.Event()
-    server = None
+    # Supervisor-managed stand-in for the fabric's ``inference_serve``
+    # loop: serve_once is re-enterable (pending requests live in service
+    # state), so a crash restarts cleanly instead of wedging every
+    # blocked fleet — same discipline train() gives the real loop
+    serve_sup = None
     if plane.service is not None:
+        from r2d2_tpu.utils.supervisor import Supervisor
+
+        serve_sup = Supervisor(max_restarts=3)
+
         def _serve_loop():
             while not serve_stop.is_set():
                 plane.service.serve_once()
-
-        server = threading.Thread(target=_serve_loop, daemon=True,
-                                  name="bench-serve")
     # a burst = one block per lane, so burst k starts at event index k*L
     lanes = [spec.hi - spec.lo for spec in plane.specs]
     need = [2 * L + 1 for L in lanes]     # through burst 2's first block
@@ -258,8 +266,8 @@ def _actor_plane_bench_process(num_lanes: int = 64, fleets: int = 2,
 
     try:
         plane.start(store)
-        if server is not None:
-            server.start()
+        if serve_sup is not None:
+            serve_sup.start("bench_serve", _serve_loop)
         deadline = time.time() + budget_s
         while (time.time() < deadline
                and any(len(ev) < n for ev, n in zip(events, need))):
@@ -273,8 +281,8 @@ def _actor_plane_bench_process(num_lanes: int = 64, fleets: int = 2,
         # act channels: a mid-iteration serve_once still holds slab views,
         # and SharedMemory.close under live views raises BufferError
         serve_stop.set()
-        if server is not None:
-            server.join(10)
+        if serve_sup is not None:
+            serve_sup.join_all(10)
         plane.shutdown()
 
     rate = 0.0

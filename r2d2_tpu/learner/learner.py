@@ -179,49 +179,55 @@ class Learner:
             self.num_updates + max_steps)
 
         # prefetch_batches == 0 → fully synchronous staging (deterministic;
-        # used by train_sync and tests).  Otherwise a daemon thread keeps up
-        # to ``prefetch_batches`` device-resident batches ahead of compute.
+        # used by train_sync and tests).  Otherwise a Supervisor-managed
+        # thread keeps up to ``prefetch_batches`` device-resident batches
+        # ahead of compute.  Supervision (vs the former bare daemon
+        # thread): a transient staging crash — an H2D hiccup, a flaky
+        # batch source — restarts the loop and the run continues, and only
+        # an exhausted restart budget ends the stream; the loop is
+        # re-enterable because its whole state is the bounded queue.
+        pf_sup = None
         if cfg.prefetch_batches > 0:
+            from r2d2_tpu.utils.supervisor import Supervisor
+
             staged: "queue.Queue" = queue.Queue(maxsize=cfg.prefetch_batches)
             done = threading.Event()
 
             def prefetch():
-                try:
+                while not done.is_set():
+                    batch = batch_source()
+                    item = None if batch is None else self._stage(batch)
+                    # bounded put that re-checks done: when the learner
+                    # stops consuming with the queue full, the thread
+                    # must exit rather than park in put() forever (and
+                    # pin device-resident staged batches).  A None item is
+                    # the end-of-stream sentinel — delivered through the
+                    # queue, so a supervised restart after a crash can
+                    # never fabricate one.
                     while not done.is_set():
-                        batch = batch_source()
-                        item = None if batch is None else self._stage(batch)
-                        # bounded put that re-checks done: when the learner
-                        # stops consuming with the queue full, the thread
-                        # must exit rather than park in put() forever (and
-                        # pin device-resident staged batches)
-                        while not done.is_set():
-                            try:
-                                staged.put(item, timeout=0.1)
-                                break
-                            except queue.Full:
-                                continue
-                        if batch is None:
-                            return
-                finally:
-                    # exception-safe end-of-stream sentinel so the consumer
-                    # can never block on a dead producer
-                    try:
-                        staged.put_nowait(None)
-                    except queue.Full:
-                        pass
+                        try:
+                            staged.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if batch is None:
+                        return
 
-            pf = threading.Thread(target=prefetch, daemon=True,
-                                  name="prefetch")
-            pf.start()
+            pf_sup = Supervisor(max_restarts=2, backoff=0.1)
+            pf_thread = pf_sup.start("learner_prefetch", prefetch)
 
             def next_item():
-                # timeout + liveness check: a producer that died with the
-                # queue full could not even enqueue its sentinel
+                # timeout + liveness check: a producer that exhausted its
+                # restart budget with the queue empty can never enqueue
+                # its sentinel — only then give up (between a crash and
+                # its supervised restart the thread is briefly not alive,
+                # which must NOT end the stream)
                 while True:
                     try:
                         return staged.get(timeout=0.5)
                     except queue.Empty:
-                        if not pf.is_alive():
+                        if pf_sup.any_failed or (not pf_thread.alive
+                                                 and done.is_set()):
                             return None
         else:
             done = threading.Event()
@@ -323,6 +329,10 @@ class Learner:
                 harvest(pending.popleft())
         finally:
             done.set()
+            if pf_sup is not None:
+                # stop supervision (cancels any pending backoff timer) and
+                # reap the prefetch thread; it exits at its next done poll
+                pf_sup.join_all(timeout=2.0)
 
         if self.checkpointer is not None:
             self._save(self.num_updates, t0)

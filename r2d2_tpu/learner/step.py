@@ -35,6 +35,7 @@ from flax import struct
 
 from r2d2_tpu.config import Config
 from r2d2_tpu.models.network import R2D2Network
+from r2d2_tpu.utils.trace import RETRACES
 
 
 def value_rescale(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
@@ -223,7 +224,12 @@ def make_train_step(cfg: Config, net: R2D2Network):
 
 
 def jit_train_step(cfg: Config, net: R2D2Network):
-    return jax.jit(make_train_step(cfg, net), donate_argnums=(0,))
+    # retrace-guarded: the step's shapes are static per config, so any
+    # retrace after the first compile is a silent perf bug — the e2e
+    # tests assert RETRACES stays within these budgets (utils/trace.py)
+    return jax.jit(RETRACES.wrap("learner.train_step",
+                                 make_train_step(cfg, net)),
+                   donate_argnums=(0,))
 
 
 def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
@@ -267,7 +273,9 @@ def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
 
 
 def make_super_step(cfg: Config, net: R2D2Network, k: int):
-    return jax.jit(make_super_step_fn(cfg, net, k), donate_argnums=(0,))
+    return jax.jit(RETRACES.wrap("learner.super_step",
+                                 make_super_step_fn(cfg, net, k)),
+                   donate_argnums=(0,))
 
 
 def _compensated_cumsum(x):
@@ -415,5 +423,7 @@ def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
 
 
 def make_in_graph_per_super_step(cfg: Config, net: R2D2Network, k: int):
-    return jax.jit(make_in_graph_per_super_step_fn(cfg, net, k),
+    return jax.jit(RETRACES.wrap("learner.in_graph_per_super_step",
+                                 make_in_graph_per_super_step_fn(cfg, net,
+                                                                 k)),
                    donate_argnums=(0, 2))

@@ -76,6 +76,7 @@ from r2d2_tpu.replay.block import (
     slot_views,
     write_block,
 )
+from r2d2_tpu.utils.trace import HOST_TRANSFERS
 
 log = logging.getLogger(__name__)
 
@@ -312,7 +313,13 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                     continue
                 store.publish(_decode_pump(payload)[1])
 
-        threading.Thread(target=weight_drain, daemon=True,
+        # fire-and-forget safe: the drain only republishes pumped weight
+        # snapshots into this subprocess's local ParamStore — if it dies,
+        # acting continues on the last published version (bounded
+        # staleness), and the fleet watchdog's restart budget is the
+        # recovery story for anything worse.  A Supervisor in the child
+        # would add restart machinery with no new failure it could fix.
+        threading.Thread(target=weight_drain, daemon=True,  # graftlint: disable=thread-discipline -- stale weights, not wedges, are the worst a dead drain causes
                          name=f"fleet{spec.fleet_id}-weights").start()
 
         net = create_network(cfg, action_dim)
@@ -475,6 +482,7 @@ class ProcessFleetPlane:
         if params is None:
             return None, 0
         host = jax.device_get(params)
+        HOST_TRANSFERS.count("pump.param_snapshot")
         if self.cfg.param_pump_dtype == "bfloat16":
             import ml_dtypes
 
@@ -696,6 +704,10 @@ class ProcessFleetPlane:
                 ch.release(slot)
             self._rr = (f + 1) % F
             frames = block.action.shape[0]
+            # one shm→ring crossing per block: the hot-loop transfer
+            # counter (utils/trace.py) keeps "blocks cross once, never
+            # per-field" an assertable invariant
+            HOST_TRANSFERS.count("ingest.block")
             self.blocks_ingested += 1
             self.frames_ingested += frames
             if 0 <= src < len(self.blocks_per_fleet):

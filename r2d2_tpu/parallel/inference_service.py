@@ -60,7 +60,6 @@ import contextlib
 import logging
 import threading
 import time
-import zlib
 from multiprocessing import shared_memory
 from queue import Empty
 from typing import Any, List, Optional, Sequence, Tuple
@@ -69,7 +68,8 @@ import numpy as np
 
 from r2d2_tpu.config import Config
 from r2d2_tpu.parallel.actor_procs import FleetStopped
-from r2d2_tpu.replay.block import slot_layout, slot_views
+from r2d2_tpu.replay.block import payload_crc32, slot_layout, slot_views
+from r2d2_tpu.utils.trace import HOST_TRANSFERS
 
 log = logging.getLogger(__name__)
 
@@ -98,11 +98,11 @@ def act_slot_spec(cfg: Config, action_dim: int, num_lanes: int):
 
 def act_request_crc(views: dict, seq: int, commit: bool) -> int:
     """CRC32 over the request payload plus the queue token header, so a
-    slab/token mismatch is caught along with a torn or garbled write."""
-    c = zlib.crc32(np.asarray([seq, int(commit)], np.int64).tobytes())
-    for name in _REQ_FIELDS:
-        c = zlib.crc32(views[name].tobytes(), c)
-    return c & 0xFFFFFFFF
+    slab/token mismatch is caught along with a torn or garbled write.
+    The convention (header words, payload order, mask) is replay.block's
+    — one definition across every shm channel."""
+    return payload_crc32((seq, int(commit)),
+                         [views[name] for name in _REQ_FIELDS])
 
 
 def _span(tracer, name: str):
@@ -445,6 +445,10 @@ class InferenceService:
                                       hidden_in)
             q = np.asarray(q)
             new_hidden = np.asarray(new_hidden)
+            # ONE device→host fetch per cross-fleet batch, regardless of
+            # how many fleets were pending — the guard counter makes the
+            # serve e2e test assert exactly that (utils/trace.py)
+            HOST_TRANSFERS.count("serve.act_fetch")
         lanes = 0
         with _span(tr, "serve.scatter"):
             with self._hidden_lock:

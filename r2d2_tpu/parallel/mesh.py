@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from r2d2_tpu.config import Config
 from r2d2_tpu.learner.step import TrainState, make_train_step
 from r2d2_tpu.models.network import R2D2Network
+from r2d2_tpu.utils.trace import RETRACES
 
 # device-batch fields (everything else in a replay batch is host-only
 # bookkeeping: idxes, block_ptr, env_steps)
@@ -169,7 +170,7 @@ def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
     repl = replicated(mesh)
     dp = NamedSharding(mesh, P("dp"))
     return jax.jit(
-        step,
+        RETRACES.wrap("mesh.train_step", step),
         in_shardings=(st_shard, {k: dp for k in DEVICE_BATCH_KEYS}),
         out_shardings=(st_shard, repl, dp),
         donate_argnums=(0,),
@@ -240,7 +241,7 @@ def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
     repl = replicated(mesh)
     dp_b = NamedSharding(mesh, P(None, "dp"))
     return jax.jit(
-        fn,
+        RETRACES.wrap("mesh.super_step", fn),
         in_shardings=(st_shard, ring_sharding(mesh, layout), dp_b, dp_b),
         out_shardings=(st_shard, repl, dp_b),
         donate_argnums=(0,),
@@ -293,7 +294,7 @@ def sharded_in_graph_per_super_step(cfg: Config, net: R2D2Network,
         fn = make_in_graph_per_super_step_fn(
             cfg, net, k, constrain=constrain)
         return jax.jit(
-            fn,
+            RETRACES.wrap("mesh.in_graph_per_super_step", fn),
             in_shardings=(st_shard, ring_sharding(mesh, "replicated"),
                           repl, repl, repl, repl),
             out_shardings=(st_shard, repl, repl),
@@ -365,7 +366,7 @@ def sharded_in_graph_per_super_step(cfg: Config, net: R2D2Network,
         return state, prios, losses
 
     return jax.jit(
-        super_step,
+        RETRACES.wrap("mesh.in_graph_per_super_step", super_step),
         in_shardings=(st_shard, ring_sharding(mesh, "dp"),
                       per_sh["prios"], per_sh["seq_meta"],
                       per_sh["first"], repl),

@@ -155,6 +155,24 @@ def block_slot_spec(cfg: Config, action_dim: int):
         ("crc32", (1,), np.uint32),)
 
 
+# The ONE CRC convention every shm channel shares (the block channel here,
+# the act slab in parallel/inference_service.py): int64 header words first,
+# then the payload arrays in their declared order, masked to 32 bits.  The
+# transport modules must import it rather than restate it — enforced by
+# the `wire-format` graftlint rule (r2d2_tpu/analysis/wire_format.py).
+CRC_MASK = 0xFFFFFFFF
+
+
+def payload_crc32(header, arrays) -> int:
+    """CRC32 over ``header`` (a sequence of ints, hashed as int64 words —
+    covering the shape/token metadata so a header/payload mismatch is
+    caught too) followed by ``arrays`` (numpy views, hashed in order)."""
+    c = zlib.crc32(np.asarray(list(header), np.int64).tobytes())
+    for a in arrays:
+        c = zlib.crc32(np.ascontiguousarray(a).tobytes(), c)
+    return c & CRC_MASK
+
+
 # (field, used-length selector) pairs of the payload a slot CRC covers —
 # shared by the producer (write_block) and the verifying consumer so the
 # two can never drift
@@ -169,11 +187,10 @@ def slot_crc(views: dict, k: int, n_obs: int, n_steps: int) -> int:
     """CRC32 of a block slot's used payload bytes (plus the shape header,
     so a header/payload mismatch is also caught)."""
     used = dict(k=k, n_obs=n_obs, n_steps=n_steps)
-    c = zlib.crc32(np.asarray([k, n_obs, n_steps], np.int64).tobytes())
-    for name, sel in _CRC_FIELDS:
-        c = zlib.crc32(views[name][:used[sel]].tobytes(), c)
-    c = zlib.crc32(views["priorities"].tobytes(), c)
-    return c & 0xFFFFFFFF
+    return payload_crc32(
+        (k, n_obs, n_steps),
+        [views[name][:used[sel]] for name, sel in _CRC_FIELDS]
+        + [views["priorities"]])
 
 
 def slot_layout(spec) -> Tuple[int, dict]:

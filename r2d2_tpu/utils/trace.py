@@ -13,6 +13,19 @@ the survey calls for:
 - :func:`device_profile` — a context manager around ``jax.profiler`` trace
   capture, producing a TensorBoard-loadable trace of the XLA device
   timeline for any region of the training loop.
+- :class:`RetraceGuard` — compile-boundary discipline made checkable
+  (Podracer, PAPERS.md): every jitted entry point wraps its Python
+  function in :data:`RETRACES`.wrap(name, fn, budget), so each XLA trace
+  (the Python body runs exactly once per compilation) increments a
+  per-instance counter.  A function that silently retraces per step —
+  shape drift, weak-type flapping, a host value captured as a tracer —
+  blows its budget, and the train/serve e2e tests assert
+  ``RETRACES.assert_within_budgets()`` instead of a reviewer eyeballing
+  compile logs.
+- :class:`TransferCounter` — :data:`HOST_TRANSFERS` counts the
+  device↔host crossings of the ingest and inference-service hot loops,
+  so "the serve loop fetches once per batch, not once per lane" is an
+  assertable invariant rather than a hope.
 
 Everything is thread-safe and allocation-light: spans cost two
 ``perf_counter`` calls and a lock-free float update per use, so they can
@@ -23,7 +36,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 
 class _Stat:
@@ -95,6 +108,112 @@ class Tracer:
             for name, v in self._counters.items():
                 out[f"counter.{name}"] = v
         return out
+
+
+class RetraceBudgetExceeded(AssertionError):
+    """A jitted entry point traced more often than its declared budget."""
+
+
+class _RetraceEntry:
+    __slots__ = ("name", "budget", "traces")
+
+    def __init__(self, name: str, budget: int):
+        self.name = name
+        self.budget = budget
+        self.traces = 0
+
+
+class RetraceGuard:
+    """Counts XLA traces per jitted-function *instance*.
+
+    ``wrap(name, fn, budget)`` returns a wrapper to hand to ``jax.jit``;
+    because jax runs the Python body once per compilation (and never on a
+    cache hit), the wrapper's call count IS the trace count.  Each wrap
+    call creates a fresh entry, so two learners built in one process do
+    not share a counter — the budget is "traces per compiled instance",
+    which for the fabric's static-shape entry points is 1 (plus slack).
+
+    The process-wide :data:`RETRACES` instance is what production entry
+    points register with; tests that deliberately provoke retraces use a
+    private ``RetraceGuard()`` so they never trip the global assertion.
+    """
+
+    def __init__(self, default_budget: int = 2):
+        self.default_budget = default_budget
+        self._entries: List[_RetraceEntry] = []
+        self._lock = threading.Lock()
+
+    def wrap(self, name: str, fn, budget: Optional[int] = None):
+        entry = _RetraceEntry(name, self.default_budget
+                              if budget is None else budget)
+        with self._lock:
+            self._entries.append(entry)
+
+        def traced(*args, **kwargs):
+            entry.traces += 1  # int += is GIL-atomic enough for a counter
+            return fn(*args, **kwargs)
+
+        traced.__name__ = getattr(fn, "__name__", name)
+        traced.__qualname__ = traced.__name__
+        traced.__wrapped__ = fn
+        return traced
+
+    def counts(self) -> Dict[str, int]:
+        """name → max traces observed on any single instance."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for e in self._entries:
+                out[e.name] = max(out.get(e.name, 0), e.traces)
+        return out
+
+    def over_budget(self) -> List[Tuple[str, int, int]]:
+        """(name, traces, budget) for every instance past its budget."""
+        with self._lock:
+            return [(e.name, e.traces, e.budget)
+                    for e in self._entries if e.traces > e.budget]
+
+    def assert_within_budgets(self) -> None:
+        bad = self.over_budget()
+        if bad:
+            raise RetraceBudgetExceeded(
+                "jitted entry points exceeded their retrace budgets: "
+                + "; ".join(f"{n} traced {t}x (budget {b})"
+                            for n, t, b in bad))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+class TransferCounter:
+    """Named counters for device↔host crossings on the hot loops."""
+
+    def __init__(self):
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+# process-wide instances: jitted entry points register with RETRACES at
+# build time; the ingest / inference-service loops tick HOST_TRANSFERS.
+# Subprocess fleets get their own (fresh) instances after spawn.
+RETRACES = RetraceGuard()
+HOST_TRANSFERS = TransferCounter()
 
 
 @contextlib.contextmanager

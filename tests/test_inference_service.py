@@ -320,6 +320,10 @@ def test_train_serve_mode_end_to_end():
     health stats.  Kept tier-1 as the serve transport's living proof."""
     from r2d2_tpu.train import train
 
+    from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
+
+    fetches_before = HOST_TRANSFERS.get("serve.act_fetch")
+    ingests_before = HOST_TRANSFERS.get("ingest.block")
     cfg = make_test_config(game_name="Fake", num_actors=4, actor_fleets=2,
                            actor_transport="process",
                            actor_inference="serve", training_steps=6,
@@ -342,3 +346,13 @@ def test_train_serve_mode_end_to_end():
     spans = m["trace"]
     for stage in ("serve.assemble", "serve.act", "serve.scatter"):
         assert spans[f"span.{stage}.count"] > 0
+    # runtime guards (utils/trace.py): the serve act fn — and every other
+    # jitted entry point alive in this process — stayed within its
+    # retrace budget, and the service paid exactly ONE device→host fetch
+    # per cross-fleet batch (never per lane) while ingest crossed once
+    # per block
+    RETRACES.assert_within_budgets()
+    assert HOST_TRANSFERS.get("serve.act_fetch") - fetches_before \
+        == svc["batches"]
+    assert HOST_TRANSFERS.get("ingest.block") - ingests_before \
+        == fleet["blocks_ingested"]

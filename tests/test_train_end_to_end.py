@@ -55,6 +55,12 @@ def test_train_threaded_fabric():
     assert m["buffer_training_steps"] == 40  # priority feedback all applied
     assert np.isfinite(m["mean_loss"])
     assert len(m["logs"]) > 0  # stats loop produced entries
+    # retrace discipline (utils/trace.py): the fabric's jitted entry
+    # points compiled once and stayed compiled — a per-step retrace
+    # anywhere in this run (or any earlier test) fails here
+    from r2d2_tpu.utils.trace import RETRACES
+
+    RETRACES.assert_within_budgets()
 
 
 @pytest.mark.slow
@@ -247,6 +253,10 @@ def test_host_staged_run_pipeline_depths(depth):
     assert len(sunk) == 7
     assert all(np.all(np.isfinite(p)) for _, p in sunk)
     assert np.isfinite(metrics["mean_loss"])
+    # the 7 same-shape updates traced the step exactly once per instance
+    from r2d2_tpu.utils.trace import RETRACES
+
+    RETRACES.assert_within_budgets()
 
 
 @pytest.mark.slow

@@ -60,6 +60,10 @@ def env_factory(cfg, seed):
 
 
 def main() -> int:
+    from r2d2_tpu.analysis import preflight
+
+    # fail fast on a dirty tree before hours of kill/resume cycles
+    preflight(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     chaos = "freeze_learner:every=40,dur=0.5;truncate_ckpt:p=0.3"
     transport = dict(actor_transport="thread")
     if PROCESS:

@@ -58,8 +58,11 @@ from r2d2_tpu.train import train  # noqa: E402
 
 
 def main(minutes: float = 20.0) -> int:
+    from r2d2_tpu.analysis import preflight
     from r2d2_tpu.utils.compile_cache import enable as enable_compile_cache
 
+    # fail fast on a dirty tree before burning a soak budget
+    preflight(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     enable_compile_cache()  # device soaks must not repay the big compiles
     cfg = test_config(
         game_name="Fake", num_actors=32, hidden_dim=128,
