@@ -2,10 +2,12 @@
 
 Rule families (see docs/ANALYSIS.md for the full reference):
 
-- ``jit-purity``        host effects inside jit-traced code
-- ``config-integrity``  cfg.X resolution + field liveness/docs
-- ``thread-discipline`` Supervisor-managed threads, locked shared writes
-- ``wire-format``       shm slot layout / CRC single-sourced in replay/block
+- ``jit-purity``           host effects inside jit-traced code
+- ``config-integrity``     cfg.X resolution + field liveness/docs
+- ``thread-discipline``    Supervisor-managed threads, locked shared writes
+- ``wire-format``          shm slot layout / CRC single-sourced in replay/block
+- ``telemetry-discipline`` metric names are registered literals, not
+  f-strings (the variable part belongs in a label)
 
 Importing this package registers every rule.  The analyzer itself is
 pure stdlib ``ast``: the ``r2d2_tpu`` package root does pull in jax at
@@ -27,6 +29,7 @@ from r2d2_tpu.analysis.core import (  # noqa: F401
 from r2d2_tpu.analysis import (  # noqa: F401  (import = rule registration)
     config_integrity,
     jit_purity,
+    telemetry_discipline,
     thread_discipline,
     wire_format,
 )

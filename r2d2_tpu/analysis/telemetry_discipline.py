@@ -1,0 +1,86 @@
+"""telemetry-discipline: metric names are literals, not format strings.
+
+The telemetry plane (r2d2_tpu/telemetry) is a *registry*: a metric name
+is an identity that dashboards, scrape configs, and greps key on.  An
+ad-hoc f-string name in a hot loop (``registry.inc(f"ingest.{src}")``)
+silently mints an unbounded family of series — per-entity cardinality
+that belongs in a LABEL (``registry.inc("ingest.blocks",
+fleet=str(src))``), where the name stays greppable and the label is the
+variable part.  It is also an allocation per call in loops the registry
+was specifically designed to keep allocation-light.
+
+The check: every call of a metric-writing method — ``inc``,
+``counter_max``, ``set_gauge``, ``observe``, ``declare_histogram`` on a
+registry-shaped receiver, plus the Tracer surface (``span``, ``gauge``,
+``incr``) — must pass the metric name as a plain string literal.
+Receivers are matched by name shape (``registry`` / ``metrics`` /
+``telemetry`` / ``tracer`` and ``*.registry`` etc.), the same heuristic
+family as config-integrity's receivers; bulk absorption helpers
+(``absorb_gauges``/``absorb_counters``) take a prefix + mapping and are
+exempt by design — they exist to fold fixed upstream surfaces, carry
+their own suppression where they synthesize names, and keep hot loops
+out of it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from r2d2_tpu.analysis.core import Context, Finding, rule
+
+RULE = "telemetry-discipline"
+
+# metric-writing methods whose first argument IS a metric name
+_METRIC_METHODS = ("inc", "counter_max", "set_gauge", "observe",
+                   "declare_histogram", "span", "gauge", "incr")
+
+_RECEIVER_NAMES = ("registry", "metrics", "telemetry", "tracer", "reg",
+                   "tr")
+
+
+def _is_metric_receiver(node: ast.AST) -> bool:
+    """A name that plausibly holds a MetricsRegistry or Tracer."""
+    if isinstance(node, ast.Name):
+        n = node.id.lower()
+    elif isinstance(node, ast.Attribute):
+        n = node.attr.lower()
+    else:
+        return False
+    return n in _RECEIVER_NAMES or n.endswith(
+        ("registry", "tracer", "_metrics", "telemetry"))
+
+
+def _name_arg(call: ast.Call):
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+@rule(RULE, "metric names passed to the registry/tracer must be string "
+            "literals (labels carry the variable part)")
+def check_telemetry_discipline(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and _is_metric_receiver(node.func.value)):
+                continue
+            arg = _name_arg(node)
+            if arg is None:
+                continue      # pathological call; runtime will complain
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                continue
+            kind = type(arg).__name__
+            detail = ("f-string" if isinstance(arg, ast.JoinedStr)
+                      else f"non-literal ({kind})")
+            findings.append(Finding(
+                RULE, mod.rel, node.lineno,
+                f"metric name for .{node.func.attr}() is {detail} — "
+                "register a literal name and put the variable part in a "
+                "label (telemetry/registry.py)"))
+    return findings

@@ -129,6 +129,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metavar="N",
                     help="retain only the newest N complete checkpoints "
                          "(+ replay snapshots); default keeps all")
+    pt.add_argument("--telemetry-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve /metrics (Prometheus text), /healthz and "
+                         "/statusz on 127.0.0.1:PORT (r2d2_tpu/telemetry; "
+                         "-1 = ephemeral port, default off); overrides "
+                         "cfg.telemetry_port")
     pt.add_argument("--chaos", default=None, metavar="SPEC",
                     help="fault-injection drill spec (utils/chaos.py), "
                          "e.g. 'kill_fleet:every=500;garble_block:p=0.01' "
@@ -195,6 +201,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cfg = cfg.replace(keep_checkpoints=args.keep_checkpoints)
             if args.chaos is not None:
                 cfg = cfg.replace(chaos_spec=args.chaos)
+            if args.telemetry_port is not None:
+                cfg = cfg.replace(telemetry_port=args.telemetry_port)
         except ValueError as e:
             parser.error(str(e))
         if args.sync and args.max_wall_seconds is not None:

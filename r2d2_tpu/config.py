@@ -224,6 +224,24 @@ class Config:
                                       # (utils/chaos.py), e.g.
                                       # "kill_fleet:every=500;garble_block:p=0.01"
                                       # — drills/soaks only; "" disables
+    # --- telemetry (r2d2_tpu/telemetry, docs/OBSERVABILITY.md) ------------
+    telemetry_port: int = 0           # HTTP scrape endpoint (/metrics
+                                      # Prometheus text, /healthz,
+                                      # /statusz JSON) on 127.0.0.1:
+                                      # 0 disables (default), >0 binds
+                                      # that port, -1 binds an ephemeral
+                                      # OS-assigned port (tests/multi-run
+                                      # hosts; the bound port surfaces in
+                                      # log entries and train() metrics)
+    log_history_cap: int = 512        # in-memory stats entries train()
+                                      # retains (a ring — the JSONL run
+                                      # log under <ckpt_dir>/telemetry/
+                                      # is the durable record; the old
+                                      # unbounded list leaked in soaks)
+    telemetry_log_max_bytes: int = 64_000_000  # run.jsonl size cap
+                                      # before rotation to .1/.2/...
+                                      # (append-only either way: resume
+                                      # continues the same file)
     fused_double_unroll: bool = False  # compute the online+target forwards
                                       # as ONE unroll vmapped over stacked
                                       # params: half the sequential LSTM
@@ -335,6 +353,14 @@ class Config:
             raise ValueError("replay_snapshot_interval must be >= 0")
         if self.learner_stall_timeout < 0:
             raise ValueError("learner_stall_timeout must be >= 0")
+        if not (-1 <= self.telemetry_port <= 65535):
+            raise ValueError(
+                f"telemetry_port must be in [-1, 65535] (0 = disabled, "
+                f"-1 = ephemeral), got {self.telemetry_port}")
+        if self.log_history_cap < 1:
+            raise ValueError("log_history_cap must be >= 1")
+        if self.telemetry_log_max_bytes < 1024:
+            raise ValueError("telemetry_log_max_bytes must be >= 1024")
         if self.chaos_spec:
             # fail at construction, not mid-run: parse_spec raises on an
             # unknown kind/param or a clause without a trigger

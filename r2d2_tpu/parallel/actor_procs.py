@@ -57,6 +57,7 @@ from __future__ import annotations
 import dataclasses
 import multiprocessing as mp
 import pickle
+import threading
 import time
 from multiprocessing import shared_memory
 from queue import Empty, Full
@@ -75,6 +76,13 @@ from r2d2_tpu.replay.block import (
     slot_layout,
     slot_views,
     write_block,
+)
+from r2d2_tpu.telemetry.registry import MetricsRegistry
+from r2d2_tpu.telemetry.slab import (
+    FLEET_STAT_FIELDS,
+    CounterMerger,
+    StatsSlab,
+    StatsSlabWriter,
 )
 from r2d2_tpu.utils.trace import HOST_TRANSFERS
 
@@ -196,9 +204,16 @@ class ShmBlockProducer:
         self.spec = block_slot_spec(cfg, action_dim)
         self.slot_nbytes, self.offsets = slot_layout(self.spec)
         self.stop_event = stop_event
+        # fleet-side telemetry counters, published through the stats slab
+        self.blocks_sent = 0
+        self.episodes = 0
+        self.episode_reward_sum = 0.0
 
     def send(self, block: Block, priorities: np.ndarray,
              episode_reward: Optional[float]) -> None:
+        if episode_reward is not None:
+            self.episodes += 1
+            self.episode_reward_sum += float(episode_reward)
         while True:
             if self.stop_event.is_set():
                 raise FleetStopped
@@ -211,6 +226,7 @@ class ShmBlockProducer:
                            self.slot_nbytes, slot)
         k, n_obs, n_steps = write_block(views, block, priorities)
         self.ready.put((slot, self.src, k, n_obs, n_steps, episode_reward))
+        self.blocks_sent += 1
 
     def close(self) -> None:
         try:
@@ -251,7 +267,8 @@ def _decode_pump(payload: bytes):
 def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                        spec: _FleetSpec, producer_info, weights_q,
                        stop_event, ctrl_q=None, snap_q=None,
-                       restore_snap=None, act_info=None) -> None:
+                       restore_snap=None, act_info=None,
+                       stats_info=None) -> None:
     """Entry point of one fleet subprocess.
 
     Pins JAX to the host CPU backend before any backend init (the child
@@ -269,6 +286,11 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
     ``act_info`` non-None selects serve mode: acting becomes an RPC
     through a :class:`~r2d2_tpu.parallel.inference_service.
     RemoteActClient` — no network, no weight wait, no drain thread.
+
+    ``stats_info`` attaches the telemetry stats slab
+    (telemetry/slab.py): after every run burst the fleet publishes its
+    counter vector (env steps, blocks, episodes, weight version) — CRC
+    last, no pickling — for the trainer's registry merge.
     """
     import jax
 
@@ -327,6 +349,22 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
 
     producer = ShmBlockProducer(cfg, action_dim, producer_info, stop_event,
                                 src=spec.fleet_id)
+    stats_writer = (StatsSlabWriter(stats_info)
+                    if stats_info is not None else None)
+    num_lanes = spec.hi - spec.lo
+
+    def publish_stats() -> None:
+        if stats_writer is None:
+            return
+        # lockstep fleet: one actor iteration steps every lane
+        stats_writer.publish(dict(
+            env_steps=actor.actor_steps * num_lanes,
+            blocks_produced=producer.blocks_sent,
+            episodes=producer.episodes,
+            episode_reward_sum=producer.episode_reward_sum,
+            param_version=store.get()[0],
+            incarnation=spec.incarnation,
+        ))
     # incarnation shifts both the env seeds and the exploration stream so
     # a respawned fleet explores fresh trajectories instead of replaying
     # the ones its dead predecessor already contributed
@@ -358,11 +396,16 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
     try:
         while not stop_event.is_set():
             actor.run(max_steps=256, stop=stop_event.is_set)
+            publish_stats()
             if ctrl_q is not None:
                 answer_ctrl(0.0)
     except FleetStopped:
         pass
     finally:
+        try:
+            publish_stats()   # final totals; a torn write fails its CRC
+        except Exception:
+            pass
         if ctrl_q is not None:
             # shutdown handshake: the trainer always sends one final
             # request ("snapshot" for a drain-then-save exit, "bye"
@@ -381,6 +424,8 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                 pass
         if client is not None:
             client.close()
+        if stats_writer is not None:
+            stats_writer.close()
         producer.close()
 
 
@@ -437,12 +482,28 @@ class ProcessFleetPlane:
         F = len(self.specs)
         # serve mode: the trainer-side act server (channels created per
         # spawn, hidden state per global lane; parallel/inference_service)
+        # shared metric namespace: train() swaps in the run's registry
+        # via set_registry before start(); standalone planes (tests,
+        # drills) keep this private instance — counters land either way
+        self.registry = MetricsRegistry()
+        self._declare_metrics(self.registry)
         self.service = None
         if cfg.actor_inference == "serve":
             from r2d2_tpu.parallel.inference_service import InferenceService
 
             self.service = InferenceService(cfg, action_dim, self.specs,
-                                            self.ctx)
+                                            self.ctx,
+                                            registry=self.registry)
+        # telemetry stats slab: one slot per fleet, merged monotone
+        # across respawns (telemetry/slab.py).  Plain shm, no queues —
+        # a SIGKILLed writer cannot corrupt it, so one slab serves every
+        # incarnation of every fleet.
+        self.stats_slab = StatsSlab(F, FLEET_STAT_FIELDS)
+        self.stats_merger = CounterMerger(F, FLEET_STAT_FIELDS)
+        # the log loop and the HTTP exporter's health handler both
+        # scrape; an unlocked concurrent fold would double-count a
+        # respawn's base absorption
+        self._stats_lock = threading.Lock()
         self.channels: List[Optional[ShmBlockChannel]] = [None] * F
         self._graveyard: List[ShmBlockChannel] = []
         self.stop_event = self.ctx.Event()
@@ -469,6 +530,23 @@ class ProcessFleetPlane:
     @property
     def num_fleets(self) -> int:
         return len(self.specs)
+
+    def set_registry(self, registry: MetricsRegistry) -> None:
+        """Adopt the run's shared metric registry (train() calls this
+        before :meth:`start` so plane counters land in the namespace the
+        exporter scrapes)."""
+        self.registry = registry
+        self._declare_metrics(registry)
+        if self.service is not None:
+            self.service.registry = registry
+
+    def _declare_metrics(self, registry: MetricsRegistry) -> None:
+        # block-size buckets as fractions of a full block (runts come
+        # from episode ends / step caps)
+        bl = self.cfg.block_length
+        registry.declare_histogram(
+            "ingest.block_frames",
+            [bl // 8, bl // 4, bl // 2, (3 * bl) // 4, bl])
 
     # ------------------------------------------------------------ weights
     def _snapshot_params(self):
@@ -602,7 +680,7 @@ class ProcessFleetPlane:
             args=(self.cfg, self.action_dim, self.env_factory, spec,
                   self.channels[f].producer_info(), self.weight_queues[f],
                   self.stop_event, self.ctrl_queues[f], self.snap_queues[f],
-                  restore_snap, act_info),
+                  restore_snap, act_info, self.stats_slab.writer_info(f)),
             daemon=True)
         p.start()
         self.procs[f] = p
@@ -657,8 +735,23 @@ class ProcessFleetPlane:
                     f"restart budget ({self.max_restarts}) exhausted")
             self.restarts[f] += 1
             restarted += 1
+            self.registry.inc("fleet.respawns", fleet=str(f))
             self._spawn(f)
         return restarted
+
+    def poll_fleet_stats(self) -> dict:
+        """Scrape the stats slab (every fleet slot) into the merger and
+        return the merged view: ``totals`` (counters summed across
+        fleets, monotone through respawns), ``per_fleet`` rows, and the
+        merger's own incarnation count per fleet."""
+        with self._stats_lock:
+            for f in range(self.num_fleets):
+                got = self.stats_slab.read(f)
+                if got is not None:
+                    self.stats_merger.update(f, *got)
+            return dict(totals=self.stats_merger.totals(),
+                        per_fleet=self.stats_merger.per_slot(),
+                        incarnations=self.stats_merger.incarnations())
 
     # ------------------------------------------------------------- ingest
     def ingest_once(self, sink: BlockSink, timeout: float = 0.1
@@ -710,6 +803,9 @@ class ProcessFleetPlane:
             HOST_TRANSFERS.count("ingest.block")
             self.blocks_ingested += 1
             self.frames_ingested += frames
+            # allocation-light (one bisect + 3 scalar adds): block-size
+            # distribution, e.g. episode-end runts vs full blocks
+            self.registry.observe("ingest.block_frames", frames)
             if 0 <= src < len(self.blocks_per_fleet):
                 self.blocks_per_fleet[src] += 1
             return (src, frames)
@@ -759,6 +855,7 @@ class ProcessFleetPlane:
             frames_ingested=self.frames_ingested,
             blocks_corrupt=self.blocks_corrupt,
             blocks_per_fleet=list(self.blocks_per_fleet),
+            stats=self.poll_fleet_stats(),
         )
         if self.service is not None:
             out["service"] = self.service.health()
@@ -807,6 +904,10 @@ class ProcessFleetPlane:
         for ch in list(self.channels) + self._graveyard:
             if ch is not None:
                 ch.close()
+        # final slab scrape BEFORE unlinking: the workers' shutdown
+        # publish carries their last counters into the merged view
+        self.poll_fleet_stats()
+        self.stats_slab.close()
         if self.service is not None:
             self.service.close()
         return snaps

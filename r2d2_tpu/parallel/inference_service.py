@@ -106,7 +106,7 @@ def act_request_crc(views: dict, seq: int, commit: bool) -> int:
 
 
 def _span(tracer, name: str):
-    return tracer.span(name) if tracer is not None else (
+    return tracer.span(name) if tracer is not None else (  # graftlint: disable=telemetry-discipline -- nullable-tracer pass-through; every call site passes a literal
         contextlib.nullcontext())
 
 
@@ -256,11 +256,18 @@ class InferenceService:
     """
 
     def __init__(self, cfg: Config, action_dim: int, specs: Sequence[Any],
-                 ctx):
+                 ctx, registry=None):
         self.cfg = cfg
         self.action_dim = action_dim
         self.specs = list(specs)          # per-fleet (fleet_id, lo, hi)
         self.ctx = ctx
+        # shared metric namespace (telemetry/registry.py); the owning
+        # plane swaps in the run's registry via set_registry
+        if registry is None:
+            from r2d2_tpu.telemetry.registry import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
         F = len(self.specs)
         self.channels: List[Optional[ActChannel]] = [None] * F
         self._graveyard: List[ActChannel] = []
@@ -286,6 +293,7 @@ class InferenceService:
         self.last_batch_lanes = 0
         self.peeks = 0
         self.requests_corrupt = 0
+        self.shard_resets = 0
 
     # ------------------------------------------------------------ channels
     def make_channel(self, f: int) -> ActChannel:
@@ -314,6 +322,10 @@ class InferenceService:
         spec = self.specs[f]
         with self._hidden_lock:
             self.hidden[spec.lo:spec.hi] = 0.0
+        self.shard_resets += 1
+        # a telemetry-visible record of every zeroing, per fleet — the
+        # chaos respawn drill polls/asserts this instead of sleeping
+        self.registry.inc("serve.shard_resets", fleet=str(f))
 
     def load_shard_hidden(self, f: int, hidden: np.ndarray) -> None:
         """Restore fleet ``f``'s hidden lanes from its actor snapshot
@@ -491,6 +503,7 @@ class InferenceService:
             if self.batches else 0.0,
             peeks=self.peeks,
             requests_corrupt=self.requests_corrupt,
+            shard_resets=self.shard_resets,
             param_version=self._param_version,
         )
 

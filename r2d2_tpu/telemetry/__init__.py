@@ -1,0 +1,42 @@
+"""Telemetry plane: metrics registry, persistent run log, HTTP exporter.
+
+The observability subsystem (docs/OBSERVABILITY.md):
+
+- :mod:`~r2d2_tpu.telemetry.registry` — thread-safe counters / gauges /
+  fixed-bucket histograms in one labeled namespace; Prometheus rendering.
+- :mod:`~r2d2_tpu.telemetry.slab` — cross-process stats: fleet
+  subprocesses publish counter vectors through a preallocated
+  shared-memory slab (replay/block.py CRC conventions, no pickling);
+  the trainer merges them monotone across watchdog respawns.
+- :mod:`~r2d2_tpu.telemetry.runlog` — append-only, size-rotated JSONL
+  run log under ``<ckpt_dir>/telemetry/`` (the durable stats record; a
+  SIGTERM→resume cycle yields one continuous curve).
+- :mod:`~r2d2_tpu.telemetry.exporter` — stdlib HTTP endpoint serving
+  ``/metrics`` (Prometheus text), ``/healthz``, ``/statusz``
+  (``cfg.telemetry_port`` / ``--telemetry-port``).
+- :mod:`~r2d2_tpu.telemetry.console` — the one console rendering shared
+  by ``train()``'s verbose line and ``tools/r2d2_top.py``.
+- :mod:`~r2d2_tpu.telemetry.plane` — the per-run orchestrator
+  (``Telemetry``) that ``train()`` wires through the fabric.
+"""
+from r2d2_tpu.telemetry.console import format_entry  # noqa: F401
+from r2d2_tpu.telemetry.exporter import (  # noqa: F401
+    TelemetryExporter,
+    make_exporter,
+)
+from r2d2_tpu.telemetry.plane import Telemetry  # noqa: F401
+from r2d2_tpu.telemetry.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+)
+from r2d2_tpu.telemetry.runlog import (  # noqa: F401
+    RunLog,
+    read_entries,
+    tail_entry,
+)
+from r2d2_tpu.telemetry.slab import (  # noqa: F401
+    FLEET_STAT_FIELDS,
+    CounterMerger,
+    StatsSlab,
+    StatsSlabWriter,
+)

@@ -1,0 +1,36 @@
+"""The ONE console rendering of a stats entry.
+
+``train()``'s verbose log line and the live terminal view
+(tools/r2d2_top.py) previously could not share formatting — the line was
+an inline f-string in ``log_loop``.  Both now render through
+:func:`format_entry`, so the operator sees the same line whether they
+are watching the training process's stdout, tailing the JSONL run log,
+or polling the HTTP endpoint.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def format_entry(entry: Dict[str, Any], prefix: str = "[r2d2]") -> str:
+    """One status line from a stats entry (the ``log_loop`` schema;
+    missing keys render as zeros so partial entries — e.g. an early
+    scrape — still format)."""
+    ret = entry.get("mean_episode_return", float("nan"))
+    line = (f"{prefix} updates={entry.get('training_steps', 0)} "
+            f"({entry.get('updates_per_sec', 0.0):.1f}/s) "
+            f"buffer={entry.get('buffer_size', 0)} "
+            f"env_steps={entry.get('env_steps', 0)} "
+            f"return={float(ret):.1f} "
+            f"loss={entry.get('mean_loss', float('nan')):.4f}")
+    fleet = entry.get("fleet")
+    if fleet:
+        line += f" fleets={fleet.get('alive', 0)}/{fleet.get('fleets', 0)}"
+        stats = fleet.get("stats") or {}
+        totals = stats.get("totals") or {}
+        if totals.get("env_steps"):
+            line += f" fleet_env_steps={int(totals['env_steps'])}"
+    age = entry.get("learner_heartbeat_age")
+    if age is not None and age > 5.0:
+        line += f" heartbeat_age={age:.1f}s"
+    return line

@@ -1,0 +1,177 @@
+"""The trainer-side telemetry plane: registry + run log + exporter.
+
+One :class:`Telemetry` object per ``train()`` call, wired by the fabric:
+
+- owns the :class:`~r2d2_tpu.telemetry.registry.MetricsRegistry` every
+  plane writes into (``train()`` hands the same instance to the process
+  fleet plane so respawn/ingest/serve counters land in the shared
+  namespace),
+- owns the persistent JSONL :class:`~r2d2_tpu.telemetry.runlog.RunLog`
+  under ``<ckpt_dir>/telemetry/`` (absent without a checkpoint dir —
+  ephemeral runs still get the registry and exporter),
+- optionally owns the HTTP exporter (``cfg.telemetry_port``), whose
+  supervised loop ``train()`` registers like any other fabric thread.
+
+:meth:`record` is the single scrape point, called once per log
+interval from ``log_loop`` with the assembled stats entry: it absorbs
+the entry into the registry (spans → gauges, stats → monotone counters,
+supervisor/fleet health → labeled gauges, chaos fires → counters, the
+RETRACES / HOST_TRANSFERS guard surfaces), then appends the entry to
+the run log.  Everything the registry learns is therefore also in the
+durable JSONL record — the exporter and the file never disagree by more
+than one interval.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from r2d2_tpu.telemetry.exporter import TelemetryExporter, make_exporter
+from r2d2_tpu.telemetry.registry import MetricsRegistry
+from r2d2_tpu.telemetry.runlog import RunLog
+
+
+class Telemetry:
+    """Registry + run log + exporter for one training run."""
+
+    def __init__(self, cfg, checkpoint_dir: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.cfg = cfg
+        self.registry = registry if registry is not None else (
+            MetricsRegistry())
+        self.runlog: Optional[RunLog] = None
+        if checkpoint_dir:
+            self.runlog = RunLog(
+                os.path.join(checkpoint_dir, "telemetry"),
+                max_bytes=cfg.telemetry_log_max_bytes)
+        self.exporter: Optional[TelemetryExporter] = None
+        self._bound_port = 0
+        self.last_entry: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------ exporter
+    def serve(self, health_fn) -> Optional[TelemetryExporter]:
+        """Arm the HTTP exporter per ``cfg.telemetry_port`` (None when
+        disabled).  ``/statusz`` carries the newest recorded entry."""
+        self.exporter = make_exporter(
+            self.cfg, self.registry, health_fn,
+            status_fn=lambda: dict(last_entry=self.last_entry))
+        if self.exporter is not None:
+            self._bound_port = self.exporter.port
+        return self.exporter
+
+    @property
+    def port(self) -> int:
+        """The exporter's bound port (0 = exporter never armed); stays
+        readable after close so the run's metrics can report it."""
+        return self._bound_port
+
+    # -------------------------------------------------------------- scrape
+    def record(self, entry: Dict[str, Any]) -> None:
+        """Absorb one ``log_loop`` stats entry into the registry, then
+        persist it to the run log (module docstring)."""
+        reg = self.registry
+        # headline counters (absolute values → monotone absorption)
+        reg.counter_max("learner.training_steps",
+                        entry.get("training_steps", 0))
+        reg.counter_max("replay.env_steps", entry.get("env_steps", 0))
+        # headline gauges
+        reg.set_gauge("replay.buffer_size", entry.get("buffer_size", 0))
+        reg.set_gauge("learner.updates_per_sec",
+                      entry.get("updates_per_sec", 0.0))
+        reg.set_gauge("learner.mean_loss",
+                      entry.get("mean_loss", float("nan")))
+        reg.set_gauge("actor.mean_episode_return",
+                      entry.get("mean_episode_return", float("nan")))
+        reg.set_gauge("learner.heartbeat_age_seconds",
+                      entry.get("learner_heartbeat_age", 0.0))
+        if "telemetry_port" in entry:
+            reg.set_gauge("telemetry.port", entry["telemetry_port"])
+        # interval deltas are genuine counter increments
+        if entry.get("interval_episodes"):
+            reg.inc("actor.episodes_finished", entry["interval_episodes"])
+        # tracer spans/gauges/counters ride along as telemetry gauges
+        reg.absorb_gauges("trace", entry.get("trace", {}))
+        # supervisor thread health, one labeled series per thread
+        for name, h in (entry.get("health") or {}).items():
+            reg.set_gauge("fabric.thread_alive",
+                          1.0 if h.get("alive") else 0.0, thread=name)
+            reg.counter_max("fabric.thread_restarts",
+                            h.get("restarts", 0), thread=name)
+        # chaos fires
+        for kind, n in (entry.get("chaos") or {}).items():
+            reg.counter_max("chaos.fires", n, kind=kind)
+        # process-fleet plane health (incl. the slab-merged actor stats)
+        fleet = entry.get("fleet")
+        if fleet:
+            reg.set_gauge("fleet.alive", fleet.get("alive", 0))
+            reg.set_gauge("fleet.total", fleet.get("fleets", 0))
+            reg.counter_max("fleet.restarts",
+                            sum(fleet.get("restarts", [])))
+            reg.counter_max("ingest.blocks",
+                            fleet.get("blocks_ingested", 0))
+            reg.counter_max("ingest.frames",
+                            fleet.get("frames_ingested", 0))
+            reg.counter_max("ingest.blocks_corrupt",
+                            fleet.get("blocks_corrupt", 0))
+            # slab-merged actor stats: env steps / blocks / episodes are
+            # genuine monotone counters; the reward SUM legally decreases
+            # (negative rewards) so it must travel as a gauge —
+            # counter_max would clamp it at its historical max and never
+            # export a negative value at all
+            totals = (fleet.get("stats") or {}).get("totals", {})
+            reg.counter_max("actor.env_steps",
+                            totals.get("env_steps", 0))
+            reg.counter_max("actor.blocks_produced",
+                            totals.get("blocks_produced", 0))
+            reg.counter_max("actor.episodes", totals.get("episodes", 0))
+            if "episode_reward_sum" in totals:
+                reg.set_gauge("actor.episode_reward_sum",
+                              totals["episode_reward_sum"])
+            for f, row in enumerate(
+                    (fleet.get("stats") or {}).get("per_fleet", [])):
+                lbl = str(f)
+                reg.counter_max("actor.fleet.env_steps",
+                                row.get("env_steps", 0), fleet=lbl)
+                reg.counter_max("actor.fleet.blocks_produced",
+                                row.get("blocks_produced", 0), fleet=lbl)
+                reg.counter_max("actor.fleet.episodes",
+                                row.get("episodes", 0), fleet=lbl)
+                reg.set_gauge("actor.fleet.episode_reward_sum",
+                              row.get("episode_reward_sum", 0.0),
+                              fleet=lbl)
+                reg.set_gauge("actor.fleet.param_version",
+                              row.get("param_version", 0), fleet=lbl)
+            svc = fleet.get("service")
+            if svc:
+                reg.counter_max("serve.batches", svc.get("batches", 0))
+                reg.counter_max("serve.lanes_served",
+                                svc.get("lanes_served", 0))
+                reg.counter_max("serve.requests_corrupt",
+                                svc.get("requests_corrupt", 0))
+                reg.set_gauge("serve.last_batch_lanes",
+                              svc.get("last_batch_lanes", 0))
+                reg.set_gauge("serve.param_version",
+                              svc.get("param_version", 0))
+        # the runtime guard surfaces (utils/trace.py process-wide views)
+        from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
+
+        reg.absorb_counters("host_transfers", HOST_TRANSFERS.snapshot())
+        for name, traces in RETRACES.counts().items():
+            reg.set_gauge("retraces.max_traces", traces, entry_point=name)
+
+        self.last_entry = entry
+        if self.runlog is not None:
+            self.runlog.append(entry)
+
+    def close_exporter(self) -> None:
+        """Stop serving scrapes (train()'s fabric teardown calls this
+        before joining the supervised loops — the loop is close-driven,
+        not stop-driven, so a stalled run stays scrapeable until here)."""
+        if self.exporter is not None:
+            self.exporter.close()
+            self.exporter = None
+
+    def close(self) -> None:
+        self.close_exporter()
+        if self.runlog is not None:
+            self.runlog.close()

@@ -39,6 +39,7 @@ runs one device act per step (parallel/inference_service.py).
 """
 from __future__ import annotations
 
+import collections
 import logging
 import queue
 import signal
@@ -58,6 +59,7 @@ from r2d2_tpu.learner.step import create_train_state
 from r2d2_tpu.models.network import create_network, init_params
 from r2d2_tpu.parallel.mesh import make_mesh
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.telemetry import Telemetry, format_entry
 from r2d2_tpu.utils.math import epsilon_ladder
 from r2d2_tpu.utils.store import ParamStore
 from r2d2_tpu.utils.supervisor import Heartbeat, Supervisor
@@ -401,6 +403,20 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     watchdog that stops the fabric when the learner thread freezes, and
     ``cfg.chaos_spec`` (utils/chaos.py) injects deterministic faults for
     recovery drills.
+
+    Telemetry (r2d2_tpu/telemetry, docs/OBSERVABILITY.md): every log
+    interval the stats entry is absorbed into a shared
+    :class:`~r2d2_tpu.telemetry.registry.MetricsRegistry` (spans, guard
+    counters, replay stats, chaos fires, supervisor/fleet health — the
+    process-fleet plane additionally merges actor-side counters
+    published through a shared-memory stats slab) and appended to the
+    persistent JSONL run log under ``<checkpoint_dir>/telemetry/``
+    (append-on-resume: a SIGTERM→resume soak yields one continuous
+    curve).  ``cfg.telemetry_port`` arms an HTTP exporter serving
+    ``/metrics`` (Prometheus text), ``/healthz`` and ``/statusz`` as a
+    supervised fabric thread.  The in-memory ``metrics["logs"]`` list is
+    a ``cfg.log_history_cap`` ring — the JSONL file is the durable
+    record.
     """
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     cfg = sys["cfg"]  # the EFFECTIVE config (degrade paths flip flags)
@@ -411,6 +427,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     plane = sys["plane"]
     tracer = tracer or Tracer()
     supervisor = Supervisor(max_restarts=max_thread_restarts)
+    telemetry = Telemetry(cfg, checkpoint_dir)
 
     chaos = None
     if cfg.chaos_spec:
@@ -422,6 +439,9 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     if plane is not None:
         # CRC-failed blocks dropped at ingest surface in buffer.stats()
         plane.on_corrupt = buffer.note_corrupt_block
+        # the plane's counters (respawns, ingest histogram, serve shard
+        # resets, slab-merged actor stats) land in the run's namespace
+        plane.set_registry(telemetry.registry)
         if plane.service is not None:
             # serve loop spans (assemble/act/scatter) + batch-size gauge
             # land in the same tracer snapshot as every other stage
@@ -508,7 +528,33 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             with tracer.span("buffer.update_priorities"):
                 buffer.update_priorities(idxes, priorities, old_ptr, loss)
 
-    logs: List[Dict[str, Any]] = []
+    # bounded ring (cfg.log_history_cap): the JSONL run log is the
+    # durable record; this is the in-memory tail metrics["logs"] returns
+    # (the old unbounded list leaked ~1 entry/interval forever in soaks)
+    logs: "collections.deque" = collections.deque(
+        maxlen=cfg.log_history_cap)
+
+    def healthz() -> Dict[str, Any]:
+        """The /healthz verdict: ok=False on any fabric-failing signal
+        OR a heartbeat past its stall budget (the exporter keeps
+        answering while the learner is merely frozen, so an external
+        prober sees the stall the moment it exceeds the budget — before
+        the watchdog has necessarily fired)."""
+        age = heartbeat.age()
+        stale = (cfg.learner_stall_timeout > 0
+                 and age > cfg.learner_stall_timeout)
+        out = dict(
+            ok=not (supervisor.any_failed or stall["stalled"] or stale
+                    or (plane is not None and plane.failed)),
+            learner_heartbeat_age=age,
+            learner_stalled=stall["stalled"] or stale,
+            threads=supervisor.health(),
+        )
+        if plane is not None:
+            h = plane.health()
+            out["fleet"] = dict(fleets=h["fleets"], alive=h["alive"],
+                                restarts=h["restarts"], failed=h["failed"])
+        return out
 
     def log_loop():
         last_steps, last_time = 0, time.time()
@@ -529,22 +575,23 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 mean_episode_return=(s["episode_reward"] / s["num_episodes"]
                                      if s["num_episodes"] else float("nan")),
                 mean_loss=(s["sum_loss"] / max(1, s["training_steps"] - last_steps)),
+                interval_episodes=s["num_episodes"],
                 trace=tracer.snapshot(),
                 health=supervisor.health(),
                 learner_heartbeat_age=heartbeat.age(),
+                telemetry_port=telemetry.port,
             )
+            if chaos is not None:
+                entry["chaos"] = chaos.counts()
             if plane is not None:
                 entry["fleet"] = plane.health()
             logs.append(entry)
+            # registry absorption + the persistent JSONL record
+            telemetry.record(entry)
             if log_sink is not None:
                 log_sink(entry)
             if verbose:
-                print(f"[r2d2] updates={entry['training_steps']} "
-                      f"({entry['updates_per_sec']:.1f}/s) "
-                      f"buffer={entry['buffer_size']} "
-                      f"env_steps={entry['env_steps']} "
-                      f"return={entry['mean_episode_return']:.1f} "
-                      f"loss={entry['mean_loss']:.4f}", flush=True)
+                print(format_entry(entry), flush=True)
             last_steps, last_time = s["training_steps"], now
 
     def learner_watch():
@@ -598,6 +645,19 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         loops += plane.make_loops(stop, buffer.add)
     loops += [("sample", sample_loop), ("priority", priority_loop),
               ("log", log_loop)]
+    exporter = telemetry.serve(healthz)   # None when telemetry_port == 0
+    if exporter is not None:
+        def telemetry_loop():
+            # close-driven, NOT stop-driven: a stalled/stopping run must
+            # stay scrapeable (that is when /healthz matters most); the
+            # teardown below closes the exporter before joining us
+            while not exporter.closed:
+                try:
+                    exporter.handle_once()
+                except (OSError, ValueError):
+                    return            # server closed under a late poll
+
+        loops.append(("telemetry", telemetry_loop))
     if sys["ring"] is not None:
         # device replay: the learner samples index bundles itself (cheap,
         # coupled to its dispatch) — no host batch-staging thread
@@ -660,6 +720,9 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                                           stop=learner_stop, tracer=tracer)
         finally:
             stop_event.set()
+            # before join_all: the telemetry loop exits on close, and a
+            # joined-but-serving exporter would stall the teardown
+            telemetry.close_exporter()
             supervisor.join_all(timeout=5.0)
             if plane is not None:
                 # drain-then-save: collect resumable actor snapshots from the
@@ -693,12 +756,13 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             except Exception as e:  # never fail the run over snapshot I/O
                 log.warning("full-state replay snapshot failed: %s", e)
 
-        metrics.update(buffer_size=len(buffer), logs=logs,
+        metrics.update(buffer_size=len(buffer), logs=list(logs),
                        buffer_training_steps=buffer.training_steps,
                        final_params=learner.state.params,
                        restored_replay=sys["restored_replay"],
                        learner_stalled=stall["stalled"],
                        trace=tracer.snapshot(), health=supervisor.health(),
+                       telemetry_port=telemetry.port,
                        fabric_failed=(supervisor.any_failed
                                       or (plane is not None and plane.failed)))
         if chaos is not None:
@@ -707,6 +771,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             metrics["fleet_health"] = plane.health()
         return metrics
     finally:
+        telemetry.close()
         for sig, handler in prev_handlers.items():
             try:
                 signal.signal(sig, handler)

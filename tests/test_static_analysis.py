@@ -40,9 +40,9 @@ def test_repo_tree_is_clean():
     report = run_analysis([os.path.join(REPO_ROOT, "r2d2_tpu"),
                            os.path.join(REPO_ROOT, "tools")],
                           root=REPO_ROOT)
-    assert len(report.rules) >= 4
+    assert len(report.rules) >= 5
     assert {"jit-purity", "config-integrity", "thread-discipline",
-            "wire-format"} <= set(report.rules)
+            "wire-format", "telemetry-discipline"} <= set(report.rules)
     assert report.errors == []
     assert report.findings == [], "\n".join(
         f.format() for f in report.findings)
@@ -51,6 +51,10 @@ def test_repo_tree_is_clean():
     assert suppressed_at <= {
         ("r2d2_tpu/bench.py", "thread-discipline"),
         ("r2d2_tpu/parallel/actor_procs.py", "thread-discipline"),
+        # nullable-tracer pass-through helper; call sites pass literals
+        ("r2d2_tpu/parallel/inference_service.py", "telemetry-discipline"),
+        # bulk absorption of fixed upstream surfaces (registry.absorb_*)
+        ("r2d2_tpu/telemetry/registry.py", "telemetry-discipline"),
     }, suppressed_at
 
 
@@ -378,6 +382,44 @@ def test_wire_format_suppressed():
         def legacy(buf):
             return zlib.crc32(buf)  # graftlint: disable=wire-format -- fixture
     """), rules=["wire-format"])
+    assert report.findings == [] and len(report.suppressed) == 1
+
+
+def test_telemetry_discipline_flags_fstring_and_computed_names():
+    report = analyze_source(_src("""
+        def ingest_loop(registry, tracer, src):
+            registry.inc(f"ingest.blocks.{src}")
+            registry.set_gauge("fill." + str(src), 1.0)
+            tracer.span(make_name(src))
+            self.registry.observe(f"lat.{src}", 0.1)
+    """), rules=["telemetry-discipline"])
+    assert len(report.findings) == 4
+    assert all(f.rule == "telemetry-discipline" for f in report.findings)
+    assert any("f-string" in f.message for f in report.findings)
+
+
+def test_telemetry_discipline_negative_literals_labels_and_receivers():
+    """Literal names pass — including with variable LABELS (the sanctioned
+    home for per-entity cardinality) — and non-registry receivers with
+    colliding method names are never flagged."""
+    report = analyze_source(_src("""
+        def ingest_loop(registry, tracer, src):
+            registry.inc("ingest.blocks", fleet=str(src))
+            registry.counter_max("steps", n)
+            tracer.gauge("depth", q.qsize())
+            registry.declare_histogram("lat", [1, 2, 4])
+            some_set.observe(f"not.{a}.metric")   # not a registry shape
+            obj.inc(f"free.{x}")                  # nor this
+    """), rules=["telemetry-discipline"])
+    assert report.findings == []
+
+
+def test_telemetry_discipline_suppressed_with_reason():
+    report = analyze_source(_src("""
+        def absorb(registry, mapping, prefix):
+            for k, v in mapping.items():
+                registry.set_gauge(f"{prefix}.{k}", v)  # graftlint: disable=telemetry-discipline -- fixture
+    """), rules=["telemetry-discipline"])
     assert report.findings == [] and len(report.suppressed) == 1
 
 

@@ -1,0 +1,496 @@
+"""Telemetry plane (ISSUE 5): registry semantics, cross-process slab
+merge, JSONL run-log durability, exporter endpoint contracts, and the
+train() acceptance e2es (fleet-aggregated /metrics, /healthz flipping on
+a chaos-stalled heartbeat, SIGTERM→resume continuity of run.jsonl, the
+bounded in-memory logs ring).
+"""
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.telemetry import (
+    CounterMerger,
+    MetricsRegistry,
+    RunLog,
+    Telemetry,
+    TelemetryExporter,
+    format_entry,
+    make_exporter,
+    read_entries,
+    tail_entry,
+)
+from r2d2_tpu.telemetry.slab import StatsSlab, StatsSlabWriter
+from r2d2_tpu.train import train
+
+A = 4
+
+
+def env_factory(cfg, seed):
+    return FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=seed,
+                        episode_len=32)
+
+
+# ------------------------------------------------------------- registry
+
+def test_registry_counters_gauges_labels():
+    r = MetricsRegistry()
+    r.inc("ingest.blocks", 2)
+    r.inc("ingest.blocks", 3)
+    r.inc("ingest.blocks", 1, fleet="0")
+    assert r.get_counter("ingest.blocks") == 5
+    assert r.get_counter("ingest.blocks", fleet="0") == 1
+    with pytest.raises(ValueError, match="negative"):
+        r.inc("ingest.blocks", -1)
+    r.set_gauge("fill", 7.0)
+    r.set_gauge("fill", 3.0)
+    assert r.get_gauge("fill") == 3.0
+    snap = r.snapshot()
+    assert snap["counters"]["ingest.blocks"] == 5
+    assert snap["counters"]["ingest.blocks{fleet=0}"] == 1
+    assert snap["gauges"]["fill"] == 3.0
+
+
+def test_registry_counter_max_is_monotone_and_idempotent():
+    """The absorption path for absolute external counters: re-absorbing
+    the same snapshot changes nothing, and a restarted source (smaller
+    value) can never drag the series backwards."""
+    r = MetricsRegistry()
+    r.counter_max("steps", 10)
+    r.counter_max("steps", 10)
+    assert r.get_counter("steps") == 10
+    r.counter_max("steps", 4)      # restarted source
+    assert r.get_counter("steps") == 10
+    r.counter_max("steps", 12)
+    assert r.get_counter("steps") == 12
+
+
+def test_histogram_bucket_math_against_numpy_oracle():
+    """Fixed-bucket counts must match a numpy histogram over the same
+    (inclusive-upper-bound) edges, and the rendered cumulative buckets
+    must be the running sum."""
+    bounds = [0.5, 1.0, 2.0, 8.0]
+    rng = np.random.default_rng(0)
+    values = np.concatenate([rng.uniform(0, 10, 500), np.asarray(bounds)])
+    r = MetricsRegistry()
+    r.declare_histogram("lat", bounds)
+    for v in values:
+        r.observe("lat", float(v))
+    h = r.snapshot()["histograms"]["lat"]
+    # oracle: bucket i counts values in (bounds[i-1], bounds[i]]
+    edges = np.concatenate([[-np.inf], bounds, [np.inf]])
+    oracle, _ = np.histogram(values, bins=edges)
+    # np.histogram's bins are half-open [lo, hi) except the last; our
+    # buckets are (lo, hi] — values AT an edge differ. Count directly:
+    direct = []
+    prev = -np.inf
+    for b in list(bounds) + [np.inf]:
+        direct.append(int(((values > prev) & (values <= b)).sum()))
+        prev = b
+    assert h["counts"] == direct
+    assert h["count"] == len(values)
+    assert np.isclose(h["sum"], values.sum())
+    # rendered cumulative le buckets are the running sum
+    txt = r.render_prometheus()
+    cums = [int(line.rsplit(" ", 1)[1]) for line in txt.splitlines()
+            if line.startswith("r2d2_lat_bucket")]
+    assert cums == list(np.cumsum(direct))
+    assert cums[-1] == len(values)
+
+
+def test_prometheus_rendering_contract():
+    r = MetricsRegistry()
+    r.inc("a.b", 2, path='we"ird\\lab\nel')
+    r.set_gauge("g", float("nan"))
+    txt = r.render_prometheus()
+    assert "# TYPE r2d2_a_b_total counter" in txt
+    # label escaping: backslash, quote, newline
+    assert r'path="we\"ird\\lab\nel"' in txt
+    assert "r2d2_g NaN" in txt
+    assert txt.endswith("\n")
+
+
+# ------------------------------------------------- cross-process slab
+
+def test_stats_slab_roundtrip_and_crc_rejects_garble():
+    slab = StatsSlab(2)
+    w = StatsSlabWriter(slab.writer_info(0))
+    try:
+        assert slab.read(0) is None          # never published
+        w.publish(dict(env_steps=10, blocks_produced=2, incarnation=0))
+        seq, values = slab.read(0)
+        assert seq == 1 and values[0] == 10
+        # garble a byte inside slot 0: the CRC gate must reject it
+        buf = np.frombuffer(slab.shm.buf, np.uint8)
+        buf[8] ^= 0xFF
+        assert slab.read(0) is None
+        buf[8] ^= 0xFF                       # restore -> valid again
+        assert slab.read(0) is not None
+        del buf         # release the exported view before slab.close()
+    finally:
+        w.close()
+        slab.close()
+    assert slab.read(0) is None              # closed slab reads None
+
+
+def test_counter_merge_monotone_across_respawn():
+    """THE merge-semantics oracle: counters summed across fleets must
+    never regress through a respawn (fresh process, counters restart at
+    zero, publish seq restarts, incarnation bumps) — including counters
+    that legally decrease in value (negative reward sums)."""
+    slab = StatsSlab(2)
+    m = CounterMerger(2)
+
+    def publish_and_merge(writer, slot, **stats):
+        writer.publish(stats)
+        m.update(slot, *slab.read(slot))
+        return m.totals()
+
+    w0 = StatsSlabWriter(slab.writer_info(0))
+    w1 = StatsSlabWriter(slab.writer_info(1))
+    try:
+        publish_and_merge(w0, 0, env_steps=100, episode_reward_sum=-5.0,
+                          incarnation=0)
+        t = publish_and_merge(w1, 1, env_steps=40, episode_reward_sum=-1.0,
+                              incarnation=0)
+        assert t["env_steps"] == 140 and t["episode_reward_sum"] == -6.0
+        # fleet 1 respawns: new writer, counters AND seq restart at zero
+        w1b = StatsSlabWriter(slab.writer_info(1))
+        t2 = publish_and_merge(w1b, 1, env_steps=7,
+                               episode_reward_sum=-0.5, incarnation=1)
+        w1b.close()
+        assert t2["env_steps"] == 147          # 100 + (40 folded + 7)
+        assert t2["episode_reward_sum"] == -6.5
+        assert m.incarnations() == [0, 1]
+        # idempotent re-read of the same seq
+        m.update(1, *slab.read(1))
+        assert m.totals()["env_steps"] == 147
+        # monotone within an incarnation too
+        w0.publish(dict(env_steps=120, episode_reward_sum=-9.0,
+                        incarnation=0))
+        m.update(0, *slab.read(0))
+        assert m.totals()["env_steps"] == 167
+    finally:
+        w0.close()
+        w1.close()
+        slab.close()
+
+
+def test_counter_merge_seq_regression_fold_without_incarnation_field():
+    """A schema without the incarnation field still folds on a seq
+    regression (producer restarted outside the watchdog)."""
+    fields = (("n", "counter"),)
+    m = CounterMerger(1, fields)
+    m.update(0, 5, np.asarray([10.0]))
+    m.update(0, 1, np.asarray([3.0]))      # seq regressed: new stream
+    assert m.totals()["n"] == 13.0
+
+
+def test_counter_merge_seq_regression_fold_with_same_incarnation():
+    """A producer restart that does NOT bump the incarnation (restarted
+    outside the watchdog) must still fold on the seq regression — the
+    incarnation field must not mask it."""
+    fields = (("n", "counter"), ("incarnation", "gauge"))
+    m = CounterMerger(1, fields)
+    m.update(0, 50, np.asarray([10_000.0, 0.0]))
+    m.update(0, 1, np.asarray([3.0, 0.0]))   # same inc, seq restarted
+    assert m.totals()["n"] == 10_003.0
+    # and the fresh stream keeps accumulating normally
+    m.update(0, 2, np.asarray([7.0, 0.0]))
+    assert m.totals()["n"] == 10_007.0
+
+
+def test_record_exports_negative_reward_sum_as_gauge_not_counter():
+    """Reward sums legally go negative and decrease; routing them
+    through the counter path would clamp at the historical max and
+    never export a negative value at all."""
+    t = Telemetry(make_test_config())
+    fleet = dict(stats=dict(totals=dict(env_steps=100, episodes=3,
+                                        blocks_produced=5,
+                                        episode_reward_sum=-42.0),
+                            per_fleet=[dict(env_steps=100,
+                                            episode_reward_sum=-42.0,
+                                            param_version=2)]))
+    t.record(dict(training_steps=5, env_steps=90, fleet=fleet))
+    reg = t.registry
+    assert reg.get_counter("actor.env_steps") == 100
+    assert reg.get_gauge("actor.episode_reward_sum") == -42.0
+    assert reg.get_gauge("actor.fleet.episode_reward_sum",
+                         fleet="0") == -42.0
+    # and it tracks a further decrease (a counter_max never would)
+    fleet["stats"]["totals"]["episode_reward_sum"] = -50.0
+    t.record(dict(training_steps=6, env_steps=95, fleet=fleet))
+    assert reg.get_gauge("actor.episode_reward_sum") == -50.0
+
+
+# --------------------------------------------------------- JSONL run log
+
+def test_runlog_append_resume_and_rotation(tmp_path):
+    d = str(tmp_path / "tele")
+    log = RunLog(d, max_bytes=1024, keep=2)
+    for i in range(30):
+        log.append(dict(training_steps=i, pad="x" * 80))
+    log.close()
+    # rotation: bounded active file, rotated segments present
+    assert os.path.getsize(log.path) <= 1024
+    assert os.path.exists(log.path + ".1")
+    # resume: a new RunLog on the same dir APPENDS (never truncates)
+    log2 = RunLog(d, max_bytes=1024, keep=2)
+    log2.append(dict(training_steps=30))
+    log2.close()
+    entries = list(read_entries(log2.path))
+    steps = [e["training_steps"] for e in entries]
+    assert steps == sorted(steps), "rotated read must be oldest-first"
+    assert steps[-1] == 30
+    # keep budget: at most `keep` rotated segments
+    k = 1
+    while os.path.exists(f"{log2.path}.{k}"):
+        k += 1
+    assert k - 1 <= 2
+
+
+def test_runlog_torn_final_line_and_tail(tmp_path):
+    d = str(tmp_path / "tele")
+    log = RunLog(d)
+    log.append(dict(a=1))
+    log.append(dict(a=2))
+    log.close()
+    with open(log.path, "a", encoding="utf-8") as fh:
+        fh.write('{"a": 3, "torn": tru')     # kill -9 mid-write
+    assert [e["a"] for e in read_entries(log.path)] == [1, 2]
+    assert tail_entry(log.path)["a"] == 2
+
+
+# ------------------------------------------------------------- exporter
+
+def test_exporter_disabled_at_port_zero():
+    cfg = make_test_config()                 # telemetry_port defaults 0
+    assert cfg.telemetry_port == 0
+    assert make_exporter(cfg, MetricsRegistry(), lambda: {"ok": True}) \
+        is None
+
+
+def _serve(ex):
+    def loop():
+        while not ex.closed:
+            try:
+                ex.handle_once()
+            except (OSError, ValueError):   # closed under a late poll
+                return
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+def test_exporter_endpoint_contracts():
+    r = MetricsRegistry()
+    r.inc("a.b", 1, q='x"y')
+    health = {"ok": True, "detail": "fine"}
+    ex = TelemetryExporter(r, lambda: dict(health), port=0)
+    _serve(ex)
+    base = f"http://127.0.0.1:{ex.port}"
+    try:
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == \
+                "text/plain; version=0.0.4; charset=utf-8"
+            body = resp.read().decode()
+        assert "r2d2_a_b_total" in body and r'q="x\"y"' in body
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "application/json")
+            assert json.loads(resp.read())["ok"] is True
+        with urllib.request.urlopen(base + "/statusz") as resp:
+            status = json.loads(resp.read())
+        assert status["metrics"]["counters"]['a.b{q=x"y}'] == 1
+        assert status["health"]["detail"] == "fine"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/nope")
+        assert e.value.code == 404
+        # non-OK health -> 503 with the JSON verdict in the body
+        health["ok"] = False
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["ok"] is False
+    finally:
+        ex.close()
+
+
+# ------------------------------------------------- console / r2d2_top
+
+def test_console_formatting_shared_with_top():
+    entry = dict(training_steps=12, updates_per_sec=3.0, buffer_size=64,
+                 env_steps=999, mean_episode_return=1.5, mean_loss=0.25,
+                 fleet=dict(alive=2, fleets=2, restarts=[0, 1],
+                            blocks_ingested=5, blocks_corrupt=0,
+                            stats=dict(totals=dict(env_steps=800))))
+    line = format_entry(entry)
+    assert "updates=12" in line and "env_steps=999" in line
+    assert "fleets=2/2" in line and "fleet_env_steps=800" in line
+
+    import importlib.util
+
+    top_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "r2d2_top.py")
+    spec = importlib.util.spec_from_file_location("r2d2_top", top_path)
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    frame = top.render(entry, health=dict(ok=False, threads={}))
+    assert line in frame          # the SAME formatting path
+    assert "NOT OK" in frame
+    assert top.render({}) == "[r2d2] (no telemetry yet)"
+
+
+# ------------------------------------------------------ train() e2es
+
+@pytest.mark.timeout(600)
+def test_train_e2e_metrics_endpoint_aggregates_fleet_counters(tmp_path):
+    """Acceptance: a train() run with telemetry enabled serves /metrics
+    whose actor env-step counter is the SUM across subprocess fleets
+    (each fleet publishing through the stats slab), with per-fleet
+    labeled series alongside."""
+    from test_actor_procs import make_fake_env
+
+    cfg = make_test_config(game_name="Fake", training_steps=2000,
+                           num_actors=2, actor_fleets=2,
+                           actor_transport="process",
+                           log_interval=0.2, telemetry_port=-1)
+    seen = dict(port=0, scraped=None)
+
+    def sink(entry):
+        seen["port"] = entry["telemetry_port"]
+        totals = (entry.get("fleet") or {}).get("stats", {}).get(
+            "totals", {})
+        if seen["scraped"] is None and totals.get("env_steps", 0) > 0:
+            base = f"http://127.0.0.1:{seen['port']}"
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as resp:
+                seen["scraped"] = resp.read().decode()
+            os.kill(os.getpid(), signal.SIGTERM)   # scraped: end the run
+
+    m = train(cfg, env_factory=make_fake_env, checkpoint_dir=None,
+              verbose=False, log_sink=sink, max_wall_seconds=300)
+    assert seen["scraped"] is not None, "fleet stats never aggregated"
+    series = {}
+    for line in seen["scraped"].splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        series[name] = float(value)
+    agg = series.get("r2d2_actor_env_steps_total", 0)
+    per_fleet = [v for k, v in series.items()
+                 if k.startswith("r2d2_actor_fleet_env_steps_total{")]
+    assert agg > 0
+    assert len(per_fleet) == 2               # one labeled series per fleet
+    assert agg == sum(per_fleet)
+    assert m["telemetry_port"] == seen["port"] > 0
+
+
+@pytest.mark.timeout(600)
+def test_train_e2e_healthz_flips_on_chaos_frozen_learner():
+    """Acceptance: the chaos freeze_learner site stalls the heartbeat;
+    /healthz must flip to 503/ok=False while the learner is frozen (the
+    exporter outlives the fabric stop precisely for this), and the run
+    must end with learner_stalled set by the watchdog."""
+    cfg = make_test_config(game_name="Fake", training_steps=100000,
+                           log_interval=0.2, telemetry_port=-1,
+                           learner_stall_timeout=1.5,
+                           chaos_spec="freeze_learner:at=1,dur=10")
+    port_q = []
+    result = {}
+
+    def sink(entry):
+        if not port_q:
+            port_q.append(entry["telemetry_port"])
+
+    def run():
+        result["m"] = train(cfg, env_factory=env_factory, verbose=False,
+                            log_sink=sink, max_wall_seconds=300)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 120
+    while not port_q:
+        assert time.time() < deadline, "no log entry with the port"
+        assert t.is_alive() or "m" in result
+        time.sleep(0.05)
+    base = f"http://127.0.0.1:{port_q[0]}"
+    flipped = None
+    while time.time() < deadline and flipped is None:
+        try:
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=5) as resp:
+                assert resp.status == 200    # healthy (pre-stall)
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            flipped = json.loads(e.read())
+        except OSError:
+            break                            # run ended: exporter gone
+        time.sleep(0.1)
+    t.join(300)
+    assert flipped is not None, "/healthz never went non-OK"
+    assert flipped["ok"] is False and flipped["learner_stalled"] is True
+    assert result["m"]["learner_stalled"] is True
+
+
+@pytest.mark.timeout(600)
+def test_train_e2e_sigterm_resume_one_continuous_runlog(tmp_path):
+    """Acceptance: SIGTERM a run mid-stream, resume it — run.jsonl is
+    ONE appended file whose training_steps curve continues monotonically
+    across the restart (never truncated), readable end to end."""
+    ck = str(tmp_path / "ck")
+    cfg = make_test_config(game_name="Fake", training_steps=100000,
+                           log_interval=0.2, save_interval=10 ** 8)
+
+    def sink(entry):
+        if entry["training_steps"] >= 10:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    m1 = train(cfg, env_factory=env_factory, checkpoint_dir=ck,
+               verbose=False, log_sink=sink, max_wall_seconds=180)
+    assert 0 < m1["num_updates"] < 100000
+    path = os.path.join(ck, "telemetry", "run.jsonl")
+    first = [e["training_steps"] for e in read_entries(path)]
+    assert first and first == sorted(first)
+
+    m2 = train(cfg.replace(training_steps=m1["num_updates"] + 4),
+               env_factory=env_factory, checkpoint_dir=ck, resume=True,
+               verbose=False, max_wall_seconds=180)
+    assert m2["restored_replay"]
+    assert not os.path.exists(path + ".1"), "resume must append, not rotate"
+    steps = [e["training_steps"] for e in read_entries(path)]
+    assert len(steps) > len(first)           # the resumed run appended
+    assert steps == sorted(steps), \
+        "training_steps must continue monotonically across the restart"
+
+
+@pytest.mark.timeout(600)
+def test_train_logs_ring_capped_under_fast_log_interval(tmp_path):
+    """Acceptance: with log_interval≈0 the in-memory logs list is a
+    cfg.log_history_cap ring (the old unbounded list), while the JSONL
+    run log keeps every entry."""
+    ck = str(tmp_path / "ck")
+    cfg = make_test_config(game_name="Fake", training_steps=40,
+                           log_interval=0.01, log_history_cap=16,
+                           save_interval=10 ** 8)
+    m = train(cfg, env_factory=env_factory, checkpoint_dir=ck,
+              verbose=False, max_wall_seconds=180)
+    assert m["num_updates"] == 40
+    assert len(m["logs"]) == 16              # ring is full AND capped
+    path = os.path.join(ck, "telemetry", "run.jsonl")
+    total = sum(1 for _ in read_entries(path))
+    assert total > 16, "JSONL must retain what the ring evicted"
+    # the ring holds the NEWEST entries (same tail as the file)
+    tail = [e["time"] for e in read_entries(path)][-16:]
+    assert [e["time"] for e in m["logs"]] == tail

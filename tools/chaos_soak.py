@@ -48,6 +48,7 @@ import numpy as np  # noqa: E402
 from r2d2_tpu.checkpoint import Checkpointer  # noqa: E402
 from r2d2_tpu.config import test_config  # noqa: E402
 from r2d2_tpu.envs.fake import FakeAtariEnv  # noqa: E402
+from r2d2_tpu.telemetry.runlog import artifact_log  # noqa: E402
 from r2d2_tpu.train import train  # noqa: E402
 
 MINUTES = float(args[0]) if args else 10.0
@@ -80,44 +81,60 @@ def main() -> int:
     deadline = time.time() + MINUTES * 60
     rounds, failures = [], []
     last_updates = 0
-    with tempfile.TemporaryDirectory() as ck_dir:
-        rnd = 0
-        while time.time() < deadline:
-            rnd += 1
-            m = train(cfg, env_factory=env_factory, checkpoint_dir=ck_dir,
-                      resume=rnd > 1, verbose=False,
-                      max_wall_seconds=min(45.0, deadline - time.time()))
-            ck = Checkpointer(ck_dir)
-            rec = dict(round=rnd, updates=m["num_updates"],
-                       buffer=m["buffer_size"],
-                       restored=m.get("restored_replay"),
-                       stalled=m.get("learner_stalled"),
-                       chaos=m.get("chaos"),
-                       fleet=(m.get("fleet_health") or {}),
-                       complete_steps=ck.steps(),
-                       partial_steps=[s for s in ck.steps(complete=False)
-                                      if s not in ck.steps()],
-                       replay_steps=ck.replay_steps())
-            rounds.append(rec)
-            print(json.dumps(rec), flush=True)
+    # machine-readable per-interval telemetry across ALL rounds, each
+    # entry tagged with its round (one continuous curve over the whole
+    # kill/resume soak); train() also writes its own run.jsonl under
+    # ck_dir, but that dies with the TemporaryDirectory
+    runlog = artifact_log(OUT, "chaos_soak_telemetry.jsonl")
+    try:
+        with tempfile.TemporaryDirectory() as ck_dir:
+            rnd = 0
+            while time.time() < deadline:
+                rnd += 1
+                m = train(cfg, env_factory=env_factory,
+                          checkpoint_dir=ck_dir, resume=rnd > 1,
+                          verbose=False,
+                          log_sink=lambda e, r=rnd: runlog.append(
+                              dict(e, round=r)),
+                          max_wall_seconds=min(45.0,
+                                               deadline - time.time()))
+                ck = Checkpointer(ck_dir)
+                rec = dict(round=rnd, updates=m["num_updates"],
+                           buffer=m["buffer_size"],
+                           restored=m.get("restored_replay"),
+                           stalled=m.get("learner_stalled"),
+                           chaos=m.get("chaos"),
+                           fleet=(m.get("fleet_health") or {}),
+                           complete_steps=ck.steps(),
+                           partial_steps=[s for s in
+                                          ck.steps(complete=False)
+                                          if s not in ck.steps()],
+                           replay_steps=ck.replay_steps())
+                rounds.append(rec)
+                print(json.dumps(rec), flush=True)
 
-            # invariants a chaos round must uphold.  (num_updates may
-            # legitimately regress across rounds: a truncated final save
-            # resumes from an earlier complete step — that is the point.)
-            if rnd > 1 and not m.get("restored_replay"):
-                failures.append(f"round {rnd}: resume came up cold")
-            rep = ck.restore_replay()
-            if rep is not None:
-                meta = rep[0]
-                if meta["counters"]["size"] < 0:
-                    failures.append(f"round {rnd}: negative snapshot size")
-            if len(ck.steps()) > cfg.keep_checkpoints:
-                failures.append(f"round {rnd}: retention GC fell behind "
-                                f"({ck.steps()})")
-            last_updates = m["num_updates"]
+                # invariants a chaos round must uphold.  (num_updates may
+                # legitimately regress across rounds: a truncated final
+                # save resumes from an earlier complete step — that is
+                # the point.)
+                if rnd > 1 and not m.get("restored_replay"):
+                    failures.append(f"round {rnd}: resume came up cold")
+                rep = ck.restore_replay()
+                if rep is not None:
+                    meta = rep[0]
+                    if meta["counters"]["size"] < 0:
+                        failures.append(
+                            f"round {rnd}: negative snapshot size")
+                if len(ck.steps()) > cfg.keep_checkpoints:
+                    failures.append(f"round {rnd}: retention GC fell "
+                                    f"behind ({ck.steps()})")
+                last_updates = m["num_updates"]
+    finally:
+        runlog.close()
 
     summary = dict(minutes=MINUTES, rounds=len(rounds), failures=failures,
                    final_updates=last_updates,
+                   telemetry_jsonl=runlog.path,
                    chaos_fires=rounds[-1]["chaos"] if rounds else None)
     print(json.dumps(summary, indent=2))
     if OUT:

@@ -1,0 +1,102 @@
+"""r2d2_top: live terminal view of a training run's telemetry.
+
+Tails either source of truth (they carry the same entries):
+
+    python tools/r2d2_top.py <ckpt_dir | run.jsonl>   # the JSONL run log
+    python tools/r2d2_top.py --url http://127.0.0.1:9109   # /statusz
+
+Options: ``--interval SECS`` (default 2), ``--once`` (render one frame
+and exit — scripting/tests).  Renders through the SAME
+``telemetry.console.format_entry`` path as ``train()``'s verbose line,
+plus a health/fleet summary when present.  Stdlib only.
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from r2d2_tpu.telemetry.console import format_entry  # noqa: E402
+from r2d2_tpu.telemetry.runlog import tail_entry  # noqa: E402
+
+
+def resolve_jsonl(path: str) -> str:
+    """Accept a checkpoint dir (appends telemetry/run.jsonl) or a direct
+    JSONL path."""
+    if os.path.isdir(path):
+        return os.path.join(path, "telemetry", "run.jsonl")
+    return path
+
+
+def fetch_statusz(url: str) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/statusz",
+                                timeout=5) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def render(entry, health=None) -> str:
+    """One frame: the shared console line + health/fleet detail."""
+    if not entry:
+        return "[r2d2] (no telemetry yet)"
+    lines = [format_entry(entry)]
+    health = health if health is not None else dict(
+        threads=entry.get("health") or {})
+    threads = health.get("threads") or {}
+    dead = [n for n, h in threads.items() if not h.get("alive")]
+    restarts = sum(h.get("restarts", 0) for h in threads.values())
+    lines.append(f"  fabric: {len(threads)} threads"
+                 + (f", DEAD: {','.join(sorted(dead))}" if dead else "")
+                 + (f", restarts={restarts}" if restarts else "")
+                 + ("" if health.get("ok", True) else "  ** NOT OK **"))
+    fleet = entry.get("fleet")
+    if fleet:
+        stats = (fleet.get("stats") or {}).get("totals") or {}
+        lines.append(
+            f"  fleet: alive={fleet.get('alive')}/{fleet.get('fleets')} "
+            f"restarts={sum(fleet.get('restarts', []))} "
+            f"blocks={fleet.get('blocks_ingested', 0)} "
+            f"corrupt={fleet.get('blocks_corrupt', 0)} "
+            f"actor_env_steps={int(stats.get('env_steps', 0))}")
+    chaos = entry.get("chaos")
+    if chaos:
+        lines.append("  chaos: " + " ".join(f"{k}={v}"
+                                            for k, v in sorted(chaos.items())))
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    url, source, interval, once = None, None, 2.0, False
+    args = list(argv)
+    while args:
+        a = args.pop(0)
+        if a == "--url":
+            url = args.pop(0)
+        elif a == "--interval":
+            interval = float(args.pop(0))
+        elif a == "--once":
+            once = True
+        else:
+            source = a
+    if (url is None) == (source is None):
+        print(__doc__)
+        return 2
+    while True:
+        if url is not None:
+            try:
+                status = fetch_statusz(url)
+                frame = render(status.get("last_entry") or {},
+                               health=status.get("health"))
+            except OSError as e:
+                frame = f"[r2d2] endpoint unreachable: {e}"
+        else:
+            frame = render(tail_entry(resolve_jsonl(source)))
+        print(frame, flush=True)
+        if once:
+            return 0
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

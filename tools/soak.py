@@ -54,6 +54,7 @@ import numpy as np  # noqa: E402
 
 from r2d2_tpu.config import test_config  # noqa: E402
 from r2d2_tpu.envs.fake import FakeAtariEnv  # noqa: E402
+from r2d2_tpu.telemetry.runlog import artifact_log, read_entries  # noqa: E402
 from r2d2_tpu.train import train  # noqa: E402
 
 
@@ -76,13 +77,24 @@ def main(minutes: float = 20.0) -> int:
         **(dict(device_ring_layout="dp",
                 mesh_shape=(("dp", 4), ("mp", 2))) if DP else {}))
     t0 = time.time()
-    m = train(cfg, env_factory=lambda c, s: FakeAtariEnv(
-                  obs_shape=c.stored_obs_shape, action_dim=4, seed=s,
-                  episode_len=200),
-              use_mesh=DP, max_wall_seconds=minutes * 60.0, verbose=False)
+    # machine-readable per-interval telemetry next to the summary
+    # artifact — every stats entry, one JSON line each, so a soak is
+    # analyzable without re-running it
+    runlog = artifact_log(OUT, "soak_telemetry.jsonl")
+    try:
+        m = train(cfg, env_factory=lambda c, s: FakeAtariEnv(
+                      obs_shape=c.stored_obs_shape, action_dim=4, seed=s,
+                      episode_len=200),
+                  use_mesh=DP, max_wall_seconds=minutes * 60.0,
+                  verbose=False, log_sink=runlog.append)
+    finally:
+        runlog.close()
     wall = time.time() - t0
 
-    rates = [e["updates_per_sec"] for e in m["logs"]
+    # rates come from the JSONL (every entry of the run) — m["logs"] is
+    # now a log_history_cap ring, whose tail alone would blind the
+    # mid-vs-last decay comparison on long soaks
+    rates = [e["updates_per_sec"] for e in read_entries(runlog.path)
              if e["updates_per_sec"] > 0]
     if len(rates) >= 3:
         third = len(rates) // 3
