@@ -89,12 +89,17 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--preset", choices=sorted(_PRESETS), default="default")
     p.add_argument("--game", default=None, help="ALE game name, or 'Fake'")
     p.add_argument("--actors", type=int, default=None)
-    p.add_argument("--actor-transport", choices=("thread", "process"),
-                   default=None,
+    p.add_argument("--actor-transport",
+                   choices=("thread", "process", "anakin"), default=None,
                    help="experience-generation transport: 'thread' (one "
-                        "process, fleet threads; default) or 'process' "
+                        "process, fleet threads; default), 'process' "
                         "(subprocess fleets over a shared-memory block "
-                        "channel — use for GIL-bound envs / many cores)")
+                        "channel — use for GIL-bound envs / many cores), "
+                        "or 'anakin' (the Podracer fused on-device loop: "
+                        "env+actor+replay+learner as ONE jitted program "
+                        "over the pure-JAX fake env — zero host crossings "
+                        "on the hot path; implies device_replay and "
+                        "in_graph_per)")
     p.add_argument("--actor-inference", choices=("local", "serve"),
                    default=None,
                    help="process-transport acting: 'local' (each fleet "

@@ -121,6 +121,17 @@ class Config:
                                       # envs / multi-core hosts.  Fleet
                                       # inference runs on the host CPU
                                       # backend in this mode.
+                                      # "anakin": the Podracer fused loop
+                                      # (learner/anakin.py) — env, actor,
+                                      # replay writes and train steps run
+                                      # as ONE jitted on-device program
+                                      # over the pure-JAX env
+                                      # (envs/anakin.py); zero host
+                                      # crossings on the hot path.
+                                      # Requires a jittable env (v1: the
+                                      # fake env only) and implies
+                                      # device_replay + in_graph_per
+                                      # (train() flips them on)
     actor_inference: str = "local"    # process-transport acting:
                                       # "local": each fleet subprocess
                                       # runs its own CPU-jitted act twin
@@ -242,6 +253,18 @@ class Config:
                                       # before rotation to .1/.2/...
                                       # (append-only either way: resume
                                       # continues the same file)
+    anakin_env_steps_per_update: int = 4  # anakin transport: fused
+                                      # env/actor steps per optimizer step
+                                      # inside the super-step (the
+                                      # actor:learner cadence the threaded
+                                      # fabric gets implicitly; 4 mirrors
+                                      # train_sync's default interleave)
+    anakin_episode_len: int = 32      # anakin transport: the pure-JAX
+                                      # fake env's truncation length
+                                      # (envs/anakin.py; must be <=
+                                      # max_episode_steps — the fused
+                                      # loop relies on truncation firing
+                                      # before the episode-step cap)
     fused_double_unroll: bool = False  # compute the online+target forwards
                                       # as ONE unroll vmapped over stacked
                                       # params: half the sequential LSTM
@@ -305,10 +328,20 @@ class Config:
             raise ValueError(
                 f"actor_fleets ({self.actor_fleets}) must be in "
                 f"[1, num_actors={self.num_actors}]")
-        if self.actor_transport not in ("thread", "process"):
+        if self.actor_transport not in ("thread", "process", "anakin"):
             raise ValueError(
                 f"unknown actor_transport {self.actor_transport!r} "
-                "(expected 'thread' or 'process')")
+                "(expected 'thread', 'process' or 'anakin')")
+        if self.anakin_env_steps_per_update < 1:
+            raise ValueError("anakin_env_steps_per_update must be >= 1")
+        if self.anakin_episode_len < 1:
+            raise ValueError("anakin_episode_len must be >= 1")
+        if (self.actor_transport == "anakin"
+                and self.anakin_episode_len > self.max_episode_steps):
+            raise ValueError(
+                f"anakin_episode_len ({self.anakin_episode_len}) must be "
+                f"<= max_episode_steps ({self.max_episode_steps}) — the "
+                "fused loop has no episode-step-cap bootstrap path")
         if self.actor_inference not in ("local", "serve"):
             raise ValueError(
                 f"unknown actor_inference {self.actor_inference!r} "

@@ -1,0 +1,149 @@
+"""Pure-JAX batched FakeAtariEnv: the anakin transport's jittable env.
+
+The Podracer "Anakin" architecture (PAPERS.md) collapses actor, replay and
+learner into ONE compiled on-device program — which requires the
+environment itself to be expressible as jnp ops.  This module is the
+device twin of :class:`r2d2_tpu.envs.fake.FakeAtariEnv`: the same tiny
+learnable POMDP (hidden phase counter, bright horizontal band observation,
+truncation at ``episode_len`` with a +2 terminal bonus), vmapped over a
+``(num_lanes, ...)`` state pytree so the whole fleet steps as a handful of
+array ops inside the fused super-step (learner/anakin.py).
+
+Bit-exactness contract (pinned by tests/test_anakin.py): given the same
+initial phase and action sequence, ``step``/``observe`` reproduce the
+numpy env's observation bytes, rewards and truncation flags exactly — the
+dynamics are integer arithmetic plus the constants {0.0, 1.0, 2.0}, so
+float equality is exact.  The one divergence is *where randomness comes
+from*: the numpy env draws its reset phase from a ``np.random.Generator``,
+which has no jittable twin, so this env draws reset phases from a
+counter-based per-lane ``jax.random`` stream instead.  The parity test
+replays this env's phase draws into the numpy oracle through its
+resumable-state API (``restore_state``), which isolates the RNG-stream
+choice from the dynamics being verified.
+
+API shape (functional, all methods safe under jit/vmap/scan):
+
+- ``init_state(key) -> state``: every lane reset, phases drawn from
+  per-lane folded streams.
+- ``observe(state) -> (N, *obs_shape) uint8``: pure function of state.
+- ``step(state, actions) -> (state', reward (N,) f32, truncated (N,) bool)``:
+  no auto-reset — the caller records the post-step observation first
+  (exactly the VectorActor ordering) and then calls
+- ``reset_lanes(state, mask) -> state'``: redraw phase / zero the step
+  counter for masked lanes only.
+
+Any future jittable env (gridworlds, procgen-style) that implements this
+same four-method surface inherits the anakin fast path for free.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class AnakinFakeEnv:
+    """Vmapped, jit-safe :class:`~r2d2_tpu.envs.fake.FakeAtariEnv` twin.
+
+    State pytree (all device arrays, N = num_lanes):
+      ``phase`` (N,) int32 — the hidden phase counter (monotone within an
+      episode, like the numpy env's ``_phase``),
+      ``t`` (N,) int32 — steps into the current episode,
+      ``key`` (N, 2) uint32 — per-lane reset-phase streams.
+    """
+
+    def __init__(self, obs_shape: Tuple[int, ...] = (84, 84, 1),
+                 action_dim: int = 4, episode_len: int = 32,
+                 num_lanes: int = 1):
+        self.obs_shape = tuple(obs_shape)
+        self.action_dim = int(action_dim)
+        self.episode_len = int(episode_len)
+        self.num_lanes = int(num_lanes)
+        h = self.obs_shape[0]
+        self._rows_per_band = max(1, h // self.action_dim)
+
+    # ------------------------------------------------------------ lifecycle
+    def init_state(self, key: jax.Array) -> dict:
+        """All lanes reset: per-lane streams are ``fold_in(key, lane)`` so
+        lane phase sequences are independent and reproducible."""
+        lanes = jnp.arange(self.num_lanes, dtype=jnp.uint32)
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(lanes)
+        state = dict(
+            phase=jnp.zeros(self.num_lanes, jnp.int32),
+            t=jnp.zeros(self.num_lanes, jnp.int32),
+            key=keys,
+        )
+        return self.reset_lanes(state,
+                                jnp.ones(self.num_lanes, bool))
+
+    def reset_lanes(self, state: dict, mask: jax.Array) -> dict:
+        """Redraw the phase and zero the step counter for masked lanes
+        (the numpy env's ``reset``: ``phase = rng.integers(action_dim)``,
+        ``t = 0``).  Unmasked lanes are untouched, including their RNG
+        stream position."""
+        def draw(k):
+            k_next, sub = jax.random.split(k)
+            phase = jax.random.randint(sub, (), 0, self.action_dim,
+                                       dtype=jnp.int32)
+            return k_next, phase
+
+        new_key, new_phase = jax.vmap(draw)(state["key"])
+        return dict(
+            phase=jnp.where(mask, new_phase, state["phase"]),
+            t=jnp.where(mask, 0, state["t"]),
+            key=jnp.where(mask[:, None], new_key, state["key"]),
+        )
+
+    # ------------------------------------------------------------- dynamics
+    def observe(self, state: dict) -> jax.Array:
+        """(N, *obs_shape) uint8 — the numpy ``_obs`` band, vectorized:
+        rows [band·rpb, (band+1)·rpb) are 255, everything else 0."""
+        h = self.obs_shape[0]
+        rpb = self._rows_per_band
+        band = state["phase"] % self.action_dim            # (N,)
+        r0 = band * rpb
+        rows = jnp.arange(h, dtype=jnp.int32)              # (H,)
+        mask = ((rows[None, :] >= r0[:, None])
+                & (rows[None, :] < (r0 + rpb)[:, None]))   # (N, H)
+        extra = (1,) * (len(self.obs_shape) - 1)
+        mask = mask.reshape(mask.shape + extra)            # (N, H, 1, 1...)
+        obs = jnp.where(mask, jnp.uint8(255), jnp.uint8(0))
+        return jnp.broadcast_to(
+            obs, (state["phase"].shape[0], *self.obs_shape))
+
+    def step(self, state: dict, actions: jax.Array
+             ) -> Tuple[dict, jax.Array, jax.Array]:
+        """One lockstep env step for every lane.
+
+        Mirrors ``FakeAtariEnv.step`` exactly: reward 1.0 on the phase-
+        matching action, phase and t advance, truncation at
+        ``episode_len`` adds the +2.0 bonus.  ``terminated`` is always
+        False in the numpy env, so only ``truncated`` is returned.  Lanes
+        are NOT auto-reset — call :meth:`reset_lanes` with the truncated
+        mask after recording the post-step observation.
+        """
+        target = state["phase"] % self.action_dim
+        reward = jnp.where(actions.astype(jnp.int32) == target,
+                           jnp.float32(1.0), jnp.float32(0.0))
+        phase = state["phase"] + 1
+        t = state["t"] + 1
+        truncated = t >= self.episode_len
+        reward = reward + jnp.where(truncated, jnp.float32(2.0),
+                                    jnp.float32(0.0))
+        return (dict(phase=phase, t=t, key=state["key"]),
+                reward, truncated)
+
+    # ----------------------------------------------------- host-side mirror
+    def host_phase_draw(self, key: np.ndarray) -> Tuple[np.ndarray, int]:
+        """The host-numpy mirror of one lane's reset-phase draw — the
+        parity tests use it to force the numpy oracle's phase to this
+        env's stream (module docstring).  ``key`` is one lane's (2,)
+        uint32 key; returns ``(next_key, phase)`` with identical values
+        to the in-graph draw."""
+        k = jnp.asarray(key, jnp.uint32)
+        k_next, sub = jax.random.split(k)
+        phase = int(jax.random.randint(sub, (), 0, self.action_dim,
+                                       dtype=jnp.int32))
+        return np.asarray(k_next), phase

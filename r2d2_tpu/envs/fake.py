@@ -61,6 +61,10 @@ class FakeAtariEnv:
     def reset(self, *, seed: Optional[int] = None, **kwargs):
         if seed is not None:
             self._rng = np.random.default_rng(seed)
+            # the action space samples from the SAME generator: rebinding
+            # only self._rng left action_space._rng on the old stream, so
+            # exploration sampling was not reseeded (ISSUE 6 satellite)
+            self.action_space._rng = self._rng
         self._phase = int(self._rng.integers(self.action_space.n))
         self._t = 0
         return self._obs(), {}

@@ -1,0 +1,871 @@
+"""Anakin-mode fused on-device training loop (``actor_transport="anakin"``).
+
+The Podracer architectures paper (PAPERS.md) observes that when the
+environment itself is jittable, the actor/replay/learner split collapses:
+env-step → act → block-cut → replay-write → train-step become ONE compiled
+program, and the host's only jobs are dispatching it and reading a few
+scalars back.  This module is that program for the R2D2 stack:
+
+- the env is the pure-JAX :class:`~r2d2_tpu.envs.anakin.AnakinFakeEnv`
+  (vmapped FakeAtariEnv dynamics);
+- the actor is an in-graph twin of :class:`~r2d2_tpu.actor.VectorActor`'s
+  hot loop — per-lane ladder epsilons, LSTM carry, deferred block-boundary
+  cuts with bootstrap Q, episode lifecycle — over a device-resident twin
+  of :class:`~r2d2_tpu.replay.block.VectorLocalBuffer`;
+- block cutting reproduces :func:`~r2d2_tpu.replay.block.assemble_block`'s
+  math (window sizes, stored-hidden selection, n-step targets, actor-side
+  initial priorities) as masked static-shape jnp ops, and writes finished
+  blocks straight into the existing device ring
+  (:class:`~r2d2_tpu.replay.device_ring.DeviceRing` arrays + its
+  ``in_graph_per`` leaf/metadata state) via donated masked scatters — a
+  lane that did not cut this step scatters to the out-of-bounds sentinel
+  slot and is dropped (``mode="drop"``), so the write is one fixed-shape
+  op regardless of how many lanes cut;
+- training is the unchanged :func:`~r2d2_tpu.learner.step.make_train_step`
+  fed by the unchanged in-graph PER sampler
+  (:func:`~r2d2_tpu.learner.step._in_graph_sample` + ``gather_batch``).
+
+Each dispatch of the fused super-step runs ``k × (E env/actor steps + 1
+optimizer step)`` under ``jax.lax.scan`` (E =
+``cfg.anakin_env_steps_per_update``), crossing the host boundary exactly
+twice: one uint32 dispatch counter up, one flat (k + 5) float vector
+(losses + counter deltas) down.  Both crossings are ticked on
+``HOST_TRANSFERS`` and the e2e tests pin them to a constant per dispatch,
+independent of lane count, batch size and k — the "zero host crossings"
+acceptance gate of ROADMAP open item 2.
+
+Numerical parity with the host block cutter (pinned by
+tests/test_anakin.py): integer fields, observation bytes, gamma tails
+(host-precomputed float32 power tables, so XLA's ``pow`` never enters)
+and stored hiddens are bit-exact vs :class:`LocalBuffer`; n-step returns
+and priorities match to float32 round-off (the host accumulates those in
+float64, which CPU-jax cannot reproduce without x64 mode — the divergence
+is ≤ a few f32 ulps and covered by tolerance assertions).
+
+Unlike the host ring writer, block slots keep whatever bytes the lane's
+stream buffer held past the used window instead of zero-padding: the
+sampling clamp invariant (replay_buffer.py) already guarantees those
+positions are loss-masked, and skipping the zero-fill keeps the write a
+pure scatter.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.envs.anakin import AnakinFakeEnv
+from r2d2_tpu.learner.step import (
+    TrainState,
+    _in_graph_sample,
+    _loss_net,
+    make_train_step,
+)
+from r2d2_tpu.models.network import R2D2Network
+from r2d2_tpu.replay.device_ring import gather_batch
+from r2d2_tpu.utils.math import epsilon_ladder
+from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
+
+# host-facing stats appended to the losses in the per-dispatch result
+# vector, in this order (all float32; the deltas are per-dispatch)
+STATS_FIELDS = ("env_steps", "fill", "episodes", "reward_sum", "blocks")
+
+
+def _gamma_tables(cfg: Config):
+    """Host-precomputed float32 discount constants, bit-identical to
+    ``utils.math.n_step_gamma_tail``'s values: ``tail[e]`` is numpy's
+    float32 ``gamma ** e`` (the tail entries), ``interior`` is the python
+    ``gamma ** n`` cast to f32 (the interior fill), ``kernel[i]`` is the
+    f32-rounded f64 ``gamma ** i`` for the n-step return sum."""
+    n, g = cfg.forward_steps, cfg.gamma
+    tail = g ** np.arange(0, n + 1, dtype=np.float32)
+    interior = np.float32(g ** n)
+    kernel = (g ** np.arange(0, n, dtype=np.float64)).astype(np.float32)
+    return jnp.asarray(tail), jnp.asarray(interior), kernel
+
+
+def _make_assemble(cfg: Config, action_dim: int, done: bool):
+    """Single-lane block assembly (vmapped by the emitter): the jnp twin
+    of :func:`replay.block.assemble_block` over the lane's preallocated
+    stream/window buffers, with every per-sequence quantity computed at
+    the static maximum K and masked past ``num_sequences``.
+
+    ``done`` is static — the two call sites are statically terminal
+    (episode-end cuts) or statically bootstrapped (boundary cuts), exactly
+    like the host actor's two ``finish`` calls."""
+    BL, L, n = cfg.block_length, cfg.learning_steps, cfg.forward_steps
+    K, cap = cfg.seqs_per_block, cfg.max_block_steps
+    burn_max = cfg.burn_in_steps
+    seq_start_mode = cfg.stored_hidden_mode == "seq_start"
+    tail_tbl, interior, kernel = _gamma_tables(cfg)
+
+    def assemble(bufs: Dict[str, jnp.ndarray], prefix, size, last_q):
+        s, c = size, prefix
+        boot = (jnp.zeros(action_dim, jnp.float32) if done else last_q)
+        qv = jax.lax.dynamic_update_index_in_dim(bufs["qval"], boot, s, 0)
+
+        t = jnp.arange(BL, dtype=jnp.int32)
+        tmask = t < s
+        r = jnp.where(tmask, bufs["reward"], 0.0)
+
+        # n-step returns: sum_{i<n} gamma^i * r[t+i] (utils.math
+        # n_step_return; f32 here vs the host's f64 accumulate — ulp-level)
+        r_ext = jnp.concatenate([r, jnp.zeros(n - 1, jnp.float32)]) \
+            if n > 1 else r
+        nstep = jnp.zeros(BL, jnp.float32)
+        for i in range(n):  # static unroll, n is small (<= ~5)
+            nstep = nstep + kernel[i] * jax.lax.slice_in_dim(r_ext, i, i + BL)
+
+        # bootstrap discount tail (utils.math n_step_gamma_tail, exact:
+        # table lookups of the host's own f32 values)
+        steps_left = s - t                     # >= 1 wherever tmask
+        e = jnp.clip(steps_left, 0, n)
+        tail_val = (jnp.float32(0.0) if done else tail_tbl[e])
+        gtail = jnp.where(steps_left > n, interior, tail_val)
+        gtail = jnp.where(tmask, gtail, 0.0)
+
+        # per-sequence windows (worker.py:471-474 invariants)
+        seq = jnp.arange(K, dtype=jnp.int32)
+        num_seq = (s + L - 1) // L
+        valid = seq < num_seq
+        burn = jnp.where(valid, jnp.minimum(seq * L + c, burn_max), 0)
+        learn = jnp.where(valid, jnp.minimum(L, s - seq * L), 0)
+        fwd = jnp.where(valid,
+                        jnp.minimum(n, s + 1 - jnp.cumsum(learn)), 0)
+
+        # stored recurrent state at each sequence's burn-in start (or the
+        # reference's seq-start indexing under the compat switch)
+        hidx = seq * L if seq_start_mode else c + seq * L - burn
+        hidx = jnp.clip(hidx, 0, cap - 1)
+        hiddens = jnp.where(valid[:, None, None, None],
+                            bufs["hidden"][hidx], 0.0)
+
+        # actor-side initial priorities (block.py:104-110: plain max-Q
+        # n-step TD, replicating the reference's asymmetry vs the learner)
+        qmax = qv.max(axis=1)                                  # (BL+1,)
+        mf = jnp.minimum(s, n)
+        maxq_t = qmax[jnp.minimum(t + mf, s)]
+        q_taken = qv[t, bufs["action"].astype(jnp.int32)]
+        td = jnp.abs(nstep + gtail * maxq_t - q_taken)
+        td = jnp.where(tmask, td, 0.0)
+        td2 = td.reshape(K, L)
+        lmask = jnp.arange(L)[None, :] < learn[:, None]
+        seg_max = jnp.where(lmask, td2, 0.0).max(axis=1)
+        seg_mean = jnp.where(lmask, td2, 0.0).sum(axis=1) \
+            / jnp.maximum(learn, 1)
+        prios = jnp.where(valid, 0.9 * seg_max + 0.1 * seg_mean, 0.0)
+
+        return dict(
+            slot=dict(obs=bufs["obs"], last_action=bufs["last_action"],
+                      last_reward=bufs["last_reward"],
+                      action=bufs["action"], n_step_reward=nstep,
+                      n_step_gamma=gtail, hidden=hiddens),
+            priorities=prios,
+            meta=jnp.stack([burn, learn, fwd], axis=1).astype(jnp.int32),
+            first_burn=burn[0].astype(jnp.int32),
+            learning_total=learn.sum().astype(jnp.int32),
+        )
+
+    return assemble
+
+
+def _make_emit(cfg: Config, action_dim: int, done: bool):
+    """Batched cut-and-write: assemble every lane's candidate block, then
+    scatter the ``cut`` lanes' blocks into ring slots ``ptr..`` (logical
+    FIFO order preserved: cut lanes take consecutive slots in lane order,
+    exactly the order the host actor's per-lane sink calls would land).
+    Non-cut lanes scatter to the sentinel slot ``num_blocks`` and are
+    dropped, so the write is one fixed-shape donated update."""
+    NB, K = cfg.num_blocks, cfg.seqs_per_block
+    alpha = cfg.prio_exponent
+    assemble = jax.vmap(_make_assemble(cfg, action_dim, done))
+
+    def emit(ast, arrays, prios, seq_meta, first, cut, last_q):
+        bufs = dict(obs=ast["buf_obs"], last_action=ast["buf_last_action"],
+                    last_reward=ast["buf_last_reward"],
+                    hidden=ast["buf_hidden"], action=ast["buf_action"],
+                    reward=ast["buf_reward"], qval=ast["buf_qval"])
+        blocks = assemble(bufs, ast["prefix"], ast["size"], last_q)
+
+        cut_i = cut.astype(jnp.int32)
+        offs = jnp.cumsum(cut_i) - cut_i              # rank among cut lanes
+        slot = jnp.where(cut, (ast["ptr"] + offs) % NB, NB)   # NB = dropped
+
+        arrays = {k: arrays[k].at[slot].set(blocks["slot"][k], mode="drop")
+                  for k in arrays}
+        leaf = (slot * K)[:, None] + jnp.arange(K)[None, :]
+        prios = prios.at[leaf.reshape(-1)].set(
+            (blocks["priorities"] ** alpha).reshape(-1), mode="drop")
+        seq_meta = seq_meta.at[slot].set(blocks["meta"], mode="drop")
+        first = first.at[slot].set(blocks["first_burn"], mode="drop")
+
+        # fill accounting mirrors ReplayBuffer.add: subtract the
+        # overwritten slot's learning total, add the new one
+        slot_safe = jnp.minimum(slot, NB - 1)
+        old_tot = jnp.where(cut, ast["block_learning_total"][slot_safe], 0)
+        new_tot = jnp.where(cut, blocks["learning_total"], 0)
+        blt = ast["block_learning_total"].at[slot].set(
+            blocks["learning_total"], mode="drop")
+        ast = {**ast,
+               "ptr": (ast["ptr"] + cut_i.sum()) % NB,
+               "block_learning_total": blt,
+               "fill": ast["fill"] + (new_tot - old_tot).sum(),
+               "env_steps_d": ast["env_steps_d"] + new_tot.sum(),
+               "blocks_d": ast["blocks_d"] + cut_i.sum()}
+        return ast, arrays, prios, seq_meta, first
+
+    return emit
+
+
+def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
+                     action_dim: int):
+    """One fused env/actor step for the whole fleet — the jnp twin of one
+    ``VectorActor.run`` iteration, same sub-step order (boundary cuts with
+    this step's bootstrap Q first, then act/step/record, then episode-end
+    cuts and lane resets).  Returns ``(carry', trace)``; the production
+    scan discards ``trace`` (XLA dead-code-eliminates it), the parity
+    tests keep it to drive the host LocalBuffer oracle."""
+    N, A, BL = cfg.num_actors, action_dim, cfg.block_length
+    cap = cfg.max_block_steps
+    eps = jnp.asarray([epsilon_ladder(i, cfg.num_actors, cfg.base_eps,
+                                      cfg.eps_alpha)
+                       for i in range(cfg.num_actors)], jnp.float32)
+    act_net = _loss_net(cfg, net)  # the scan recurrence, grad-safe twin
+    emit_boundary = _make_emit(cfg, action_dim, done=False)
+    emit_done = _make_emit(cfg, action_dim, done=True)
+    lanes = jnp.arange(N)
+
+    def actor_step(params, ast, arrays, prios, seq_meta, first):
+        q, new_hidden = act_net.apply(
+            params, ast["obs"], ast["last_action"], ast["last_reward"],
+            ast["hidden"], method=R2D2Network.act)
+
+        # 1) deferred block-boundary cuts: this step's Q at the new state
+        #    is the bootstrap (worker.py:550-554 semantics, no 2nd forward)
+        pend = ast["finish_pending"]
+        ast, arrays, prios, seq_meta, first = emit_boundary(
+            ast, arrays, prios, seq_meta, first, pend, q)
+        ast = _retain_prefix(cfg, ast, pend)
+        ast = {**ast, "finish_pending": jnp.zeros(N, bool)}
+
+        # 2) ladder-epsilon exploration
+        key, k1, k2 = jax.random.split(ast["act_key"], 3)
+        explore = jax.random.uniform(k1, (N,)) < eps
+        rand_a = jax.random.randint(k2, (N,), 0, A, dtype=jnp.int32)
+        actions = jnp.where(explore, rand_a,
+                            jnp.argmax(q, axis=1).astype(jnp.int32))
+
+        # 3) env step (no auto-reset: the post-step obs is recorded first)
+        env_state = {k: ast["env_" + k] for k in ("phase", "t", "key")}
+        env_state, reward, truncated = env.step(env_state, actions)
+        obs_step = env.observe(env_state)
+
+        # 4) batched bookkeeping + local-buffer add (VectorLocalBuffer
+        #    .add_batch, one scatter per field)
+        one_hot = jnp.zeros((N, A), bool).at[lanes, actions].set(True)
+        p = ast["prefix"] + ast["size"] + 1
+        s = ast["size"]
+        ast = {**ast,
+               "buf_obs": ast["buf_obs"].at[lanes, p].set(obs_step),
+               "buf_last_action":
+                   ast["buf_last_action"].at[lanes, p].set(one_hot),
+               "buf_last_reward":
+                   ast["buf_last_reward"].at[lanes, p].set(reward),
+               "buf_hidden": ast["buf_hidden"].at[lanes, p].set(new_hidden),
+               "buf_action":
+                   ast["buf_action"].at[lanes, s].set(
+                       actions.astype(jnp.uint8)),
+               "buf_reward": ast["buf_reward"].at[lanes, s].set(reward),
+               "buf_qval": ast["buf_qval"].at[lanes, s].set(q),
+               "obs": obs_step,
+               "last_action": one_hot.astype(jnp.float32),
+               "last_reward": reward,
+               "hidden": new_hidden,
+               "size": s + 1,
+               "sum_reward": ast["sum_reward"] + reward,
+               "episode_steps": ast["episode_steps"] + 1,
+               "act_key": key,
+               "env_phase": env_state["phase"], "env_t": env_state["t"],
+               "env_key": env_state["key"]}
+
+        # 5) episode-end cuts (terminal: zero bootstrap)
+        ast, arrays, prios, seq_meta, first = emit_done(
+            ast, arrays, prios, seq_meta, first, truncated,
+            jnp.zeros((N, A), jnp.float32))
+
+        # 6) episode accounting, env reset, lane reset (VectorActor
+        #    ._reset_lane: fresh obs, zero agent state, vbuf.reset_lane)
+        ast = {**ast,
+               "episodes_d": ast["episodes_d"] + truncated.sum(),
+               "reward_d": ast["reward_d"]
+               + jnp.where(truncated, ast["sum_reward"], 0.0).sum()}
+        env_state = env.reset_lanes(env_state, truncated)
+        obs_reset = env.observe(env_state)
+        tr = truncated
+        trc = tr[:, None]
+        obs_next = jnp.where(tr.reshape((N,) + (1,) * (obs_step.ndim - 1)),
+                             obs_reset, obs_step)
+        noop = jnp.zeros((N, A), bool).at[:, 0].set(True)
+        ast = {**ast,
+               "obs": obs_next,
+               "last_action": jnp.where(trc, 0.0, ast["last_action"]),
+               "last_reward": jnp.where(tr, 0.0, ast["last_reward"]),
+               "hidden": jnp.where(tr[:, None, None, None], 0.0,
+                                   ast["hidden"]),
+               "episode_steps": jnp.where(tr, 0, ast["episode_steps"]),
+               "sum_reward": jnp.where(tr, 0.0, ast["sum_reward"]),
+               "prefix": jnp.where(tr, 0, ast["prefix"]),
+               "size": jnp.where(tr, 0, ast["size"]),
+               "buf_obs": ast["buf_obs"].at[:, 0].set(
+                   jnp.where(tr.reshape((N,) + (1,) * (obs_step.ndim - 1)),
+                             obs_reset, ast["buf_obs"][:, 0])),
+               "buf_last_action": ast["buf_last_action"].at[:, 0].set(
+                   jnp.where(trc, noop, ast["buf_last_action"][:, 0])),
+               "buf_last_reward": ast["buf_last_reward"].at[:, 0].set(
+                   jnp.where(tr, 0.0, ast["buf_last_reward"][:, 0])),
+               "buf_hidden": ast["buf_hidden"].at[:, 0].set(
+                   jnp.where(tr[:, None, None, None], 0.0,
+                             ast["buf_hidden"][:, 0])),
+               "env_phase": env_state["phase"], "env_t": env_state["t"],
+               "env_key": env_state["key"]}
+
+        # 7) deferred boundary cut next step (worker.py block-cut rule)
+        ast = {**ast,
+               "finish_pending": (ast["size"] == BL) & ~tr
+               & (ast["episode_steps"] < cfg.max_episode_steps)}
+
+        trace = dict(pending=pend, q=q, hidden=new_hidden, actions=actions,
+                     reward=reward, truncated=tr, obs_step=obs_step,
+                     obs_next=obs_next)
+        return (ast, arrays, prios, seq_meta, first), trace
+
+    return actor_step
+
+
+def _retain_prefix(cfg: Config, ast: dict, cut: jnp.ndarray) -> dict:
+    """Post-boundary-cut retention: keep the trailing ``burn_in + 1``
+    stream entries in place as the next block's warm prefix
+    (VectorLocalBuffer.finish), realised as a per-lane index-shift gather
+    applied only to cut lanes."""
+    cap = cfg.max_block_steps
+    N = ast["size"].shape[0]
+    entries = ast["prefix"] + ast["size"] + 1
+    keep = jnp.minimum(cfg.burn_in_steps + 1, entries)
+    lo = entries - keep
+    j = jnp.arange(cap, dtype=jnp.int32)
+    src = jnp.where(j[None, :] < keep[:, None], j[None, :] + lo[:, None],
+                    j[None, :])                                 # (N, cap)
+    rows = jnp.arange(N)[:, None]
+
+    def shift(name):
+        arr = ast[name]
+        shifted = arr[rows, src]
+        return jnp.where(cut.reshape((N, 1) + (1,) * (arr.ndim - 2)),
+                         shifted, arr)
+
+    return {**ast,
+            "buf_obs": shift("buf_obs"),
+            "buf_last_action": shift("buf_last_action"),
+            "buf_last_reward": shift("buf_last_reward"),
+            "buf_hidden": shift("buf_hidden"),
+            "prefix": jnp.where(cut, keep - 1, ast["prefix"]),
+            "size": jnp.where(cut, 0, ast["size"])}
+
+
+def _zero_deltas(ast: dict) -> dict:
+    """Per-dispatch counters start at zero inside the program, so the
+    returned values ARE the dispatch's deltas — the host accumulates them
+    in Python ints (no on-device counter can wrap)."""
+    return {**ast,
+            "env_steps_d": jnp.zeros((), jnp.int32),
+            "episodes_d": jnp.zeros((), jnp.int32),
+            "reward_d": jnp.zeros((), jnp.float32),
+            "blocks_d": jnp.zeros((), jnp.int32)}
+
+
+def _stats_vec(ast: dict) -> jnp.ndarray:
+    """(5,) float32, ordered as :data:`STATS_FIELDS`."""
+    return jnp.stack([
+        ast["env_steps_d"].astype(jnp.float32),
+        ast["fill"].astype(jnp.float32),
+        ast["episodes_d"].astype(jnp.float32),
+        ast["reward_d"],
+        ast["blocks_d"].astype(jnp.float32)])
+
+
+def make_anakin_state(cfg: Config, action_dim: int, env: AnakinFakeEnv,
+                      key: jax.Array) -> dict:
+    """The fused loop's full device-resident carry (host-built, one
+    device_put): env state, batched agent state, the VectorLocalBuffer
+    twin, ring pointer/accounting, and the exploration RNG."""
+    N, A, BL = cfg.num_actors, action_dim, cfg.block_length
+    cap = cfg.max_block_steps
+    obs_shape = cfg.stored_obs_shape
+    layers, H = cfg.lstm_layers, cfg.hidden_dim
+
+    env_key, act_key = jax.random.split(key)
+    env_state = env.init_state(env_key)
+    obs0 = env.observe(env_state)
+
+    buf_la = np.zeros((N, cap, A), bool)
+    buf_la[:, 0, 0] = True                    # noop one-hot at stream start
+    ast = dict(
+        env_phase=env_state["phase"], env_t=env_state["t"],
+        env_key=env_state["key"],
+        obs=obs0,
+        last_action=jnp.zeros((N, A), jnp.float32),
+        last_reward=jnp.zeros(N, jnp.float32),
+        hidden=jnp.zeros((N, 2, layers, H), jnp.float32),
+        buf_obs=jnp.zeros((N, cap, *obs_shape), jnp.uint8
+                          ).at[:, 0].set(obs0),
+        buf_last_action=jnp.asarray(buf_la),
+        buf_last_reward=jnp.zeros((N, cap), jnp.float32),
+        buf_hidden=jnp.zeros((N, cap, 2, layers, H), jnp.float32),
+        buf_action=jnp.zeros((N, BL), jnp.uint8),
+        buf_reward=jnp.zeros((N, BL), jnp.float32),
+        buf_qval=jnp.zeros((N, BL + 1, A), jnp.float32),
+        prefix=jnp.zeros(N, jnp.int32),
+        size=jnp.zeros(N, jnp.int32),
+        sum_reward=jnp.zeros(N, jnp.float32),
+        episode_steps=jnp.zeros(N, jnp.int32),
+        finish_pending=jnp.zeros(N, bool),
+        act_key=act_key,
+        ptr=jnp.zeros((), jnp.int32),
+        block_learning_total=jnp.zeros(cfg.num_blocks, jnp.int32),
+        fill=jnp.zeros((), jnp.int32),
+    )
+    return _zero_deltas(ast)
+
+
+def make_anakin_super_step(cfg: Config, net: R2D2Network,
+                           env: AnakinFakeEnv, action_dim: int):
+    """The fused program: ``k × (E env/actor steps + 1 train step)`` in one
+    dispatch.  Signature::
+
+        super_step(train_state, anakin_state, ring_arrays, prios,
+                   seq_meta, first_burn, dispatch_idx u32)
+          -> (train_state', anakin_state', ring_arrays', prios',
+              seq_meta', first_burn', flat (k + 5) f32)
+
+    All six state arguments are donated; ``flat`` is the per-inner-step
+    losses followed by the :data:`STATS_FIELDS` deltas — the dispatch's
+    ONLY device→host payload.  The sampling stream is
+    ``fold_in(PRNGKey(cfg.seed), dispatch_idx)``, matching the
+    ``in_graph_per`` drivetrain's scheme (learner/step.py).
+    """
+    k, E = cfg.superstep_k, cfg.anakin_env_steps_per_update
+    step = make_train_step(cfg, net)
+    actor_step = _make_actor_step(cfg, net, env, action_dim)
+
+    def super_step(train_state: TrainState, ast, arrays, prios, seq_meta,
+                   first, dispatch_idx):
+        ast = _zero_deltas(ast)
+        keys = jax.random.split(
+            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), dispatch_idx),
+            k)
+
+        def update(carry, key_t):
+            ts, ast, arrays, prios, seq_meta, first = carry
+
+            def env_it(c, _):
+                c2, _trace = actor_step(ts.params, *c)
+                return c2, None
+
+            (ast, arrays, prios, seq_meta, first), _ = jax.lax.scan(
+                env_it, (ast, arrays, prios, seq_meta, first), None,
+                length=E)
+            idx, w, ints = _in_graph_sample(cfg, key_t, prios, seq_meta,
+                                            first)
+            batch = gather_batch(cfg, arrays, ints, w)
+            ts, loss, new_p = step(ts, batch)
+            # same feedback exponentiation as the in_graph_per super-step
+            prios = prios.at[idx].set(new_p ** cfg.prio_exponent)
+            return (ts, ast, arrays, prios, seq_meta, first), loss
+
+        (train_state, ast, arrays, prios, seq_meta, first), losses = (
+            jax.lax.scan(update, (train_state, ast, arrays, prios,
+                                  seq_meta, first), keys))
+        flat = jnp.concatenate([losses, _stats_vec(ast)])
+        return train_state, ast, arrays, prios, seq_meta, first, flat
+
+    return jax.jit(RETRACES.wrap("learner.anakin_super_step", super_step),
+                   donate_argnums=(0, 1, 2, 3, 4, 5))
+
+
+def make_anakin_rollout(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
+                        action_dim: int, steps: int):
+    """The warm-up program: ``steps`` fused env/actor steps with ring/PER
+    writes but NO train step — dispatched until the in-graph fill counter
+    reaches ``learning_starts``.  Params are read-only (not donated)."""
+    actor_step = _make_actor_step(cfg, net, env, action_dim)
+
+    def rollout(params, ast, arrays, prios, seq_meta, first):
+        ast = _zero_deltas(ast)
+
+        def env_it(c, _):
+            c2, _trace = actor_step(params, *c)
+            return c2, None
+
+        (ast, arrays, prios, seq_meta, first), _ = jax.lax.scan(
+            env_it, (ast, arrays, prios, seq_meta, first), None,
+            length=steps)
+        return ast, arrays, prios, seq_meta, first, _stats_vec(ast)
+
+    return jax.jit(RETRACES.wrap("learner.anakin_rollout", rollout),
+                   donate_argnums=(1, 2, 3, 4, 5))
+
+
+def make_debug_rollout(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
+                       action_dim: int, steps: int):
+    """Parity-test harness: like :func:`make_anakin_rollout` but keeps the
+    per-step trace (q, hidden, actions, rewards, cut masks, observations)
+    so tests can replay the exact trajectory into the host LocalBuffer
+    oracle.  Not retrace-guarded or donated — test-only."""
+    actor_step = _make_actor_step(cfg, net, env, action_dim)
+
+    def rollout(params, ast, arrays, prios, seq_meta, first):
+        def env_it(c, _):
+            return actor_step(params, *c)
+
+        return jax.lax.scan(env_it, (ast, arrays, prios, seq_meta, first),
+                            None, length=steps)
+
+    return jax.jit(rollout)
+
+
+# --------------------------------------------------------------------------
+# host-side driver
+# --------------------------------------------------------------------------
+
+class AnakinPlane:
+    """Owns the fused loop's device state and its dispatch/harvest cycle.
+
+    The host's entire job: dispatch the compiled program, read back the
+    (k + 5)-float result vector, and keep Python-int mirrors of the
+    counters (no on-device counter can overflow that way).  Every
+    device→host crossing ticks ``HOST_TRANSFERS`` (``anakin.result_fetch``
+    once per dispatch; ``anakin.snapshot_fetch`` per full-state snapshot)
+    so the "host-free hot loop" claim is an assertable invariant.
+
+    The ring handles live in the :class:`DeviceRing` passed in — the fused
+    program donates them and the plane stores the returned generation back
+    after every dispatch, so the ring object stays the single owner (same
+    handle discipline as the ``in_graph_per`` drivetrain).
+    """
+
+    def __init__(self, cfg: Config, net: R2D2Network, action_dim: int,
+                 ring: Any, start_env_steps: int = 0):
+        if not getattr(cfg, "in_graph_per", False):
+            raise ValueError("the anakin plane requires in_graph_per=True "
+                             "(train._train_anakin flips it on)")
+        if cfg.num_blocks < cfg.num_actors:
+            raise ValueError(
+                f"anakin needs num_blocks ({cfg.num_blocks}) >= num_actors "
+                f"({cfg.num_actors}): every lane may cut a block in the "
+                "same fused step and the masked scatter writes them to "
+                "distinct slots")
+        if cfg.anakin_episode_len > cfg.max_episode_steps:
+            raise ValueError(
+                f"anakin_episode_len ({cfg.anakin_episode_len}) must be "
+                f"<= max_episode_steps ({cfg.max_episode_steps}): the "
+                "fused loop relies on truncation firing before the "
+                "episode-step cap (the cap path needs a second forward "
+                "the fused program does not run)")
+        self.cfg = cfg
+        self.ring = ring
+        self.action_dim = action_dim
+        self.env = AnakinFakeEnv(
+            obs_shape=cfg.stored_obs_shape, action_dim=action_dim,
+            episode_len=cfg.anakin_episode_len, num_lanes=cfg.num_actors)
+        # double fold_in: the PER sampling stream is the SINGLE-fold
+        # fold_in(PRNGKey(seed), dispatch_idx) over the full u32 range
+        # (learner/step.py), so a single-fold plane root would collide
+        # with one dispatch's stream — two folds is a distinct
+        # derivation path for the env/exploration streams
+        self.state = make_anakin_state(
+            cfg, action_dim, self.env,
+            jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0x414B),
+                1))
+        self.super_step = make_anakin_super_step(cfg, net, self.env,
+                                                 action_dim)
+        self.roll_steps = cfg.superstep_k * cfg.anakin_env_steps_per_update
+        self.rollout = make_anakin_rollout(cfg, net, self.env, action_dim,
+                                           steps=self.roll_steps)
+        self._frames_per_dispatch = self.roll_steps * cfg.num_actors
+
+        # host-int counter mirrors (absolute; deltas arrive per dispatch).
+        # The lock covers them: the dispatch thread folds deltas in while
+        # the log thread's stats() does a read-and-reset of the interval
+        # accumulators — same contract (and remedy) as ReplayBuffer.stats
+        self._stats_lock = threading.Lock()
+        self.env_steps = int(start_env_steps)
+        self.fill = 0
+        self.frames = 0
+        self.super_steps = 0
+        self.blocks = 0
+        self.episodes_total = 0
+        self.reward_total = 0.0
+        self.training_steps = 0
+        self.dispatch_no = 0
+        # interval accumulators, reset by stats() (ReplayBuffer.stats
+        # semantics so the log loop code is shared-shaped)
+        self._interval_episodes = 0
+        self._interval_reward = 0.0
+        self._interval_loss = 0.0
+
+    # ----------------------------------------------------------- dispatch
+    def _handles(self):
+        meta = self.ring.per_meta()
+        return (self.ring.snapshot(), self.ring.take_prios(),
+                meta["seq_meta"], meta["first"])
+
+    def _store(self, arrays, prios, seq_meta, first) -> None:
+        self.ring.arrays = arrays
+        self.ring.put_prios(prios)
+        self.ring.put_per_meta(seq_meta, first)
+
+    def rollout_step(self, params) -> None:
+        """One warm-up dispatch (env/actor/ring-write only), harvested
+        synchronously — the fill counter gates the switch to training."""
+        ast, arrays, prios, seq_meta, first, stats = self.rollout(
+            params, self.state, *self._handles())
+        self.state = ast
+        self._store(arrays, prios, seq_meta, first)
+        with self._stats_lock:
+            self.frames += self._frames_per_dispatch
+        HOST_TRANSFERS.count("anakin.result_fetch")
+        self._absorb(np.asarray(jax.device_get(stats)))
+
+    def dispatch(self, train_state: TrainState):
+        """One fused super-step dispatch.  Returns ``(train_state', flat)``
+        with the result vector's D2H copy already started — harvest later
+        (pipelined) via :meth:`harvest`."""
+        idx = jnp.asarray(self.dispatch_no & 0xFFFFFFFF, jnp.uint32)
+        self.dispatch_no += 1
+        train_state, ast, arrays, prios, seq_meta, first, flat = (
+            self.super_step(train_state, self.state, *self._handles(), idx))
+        self.state = ast
+        self._store(arrays, prios, seq_meta, first)
+        with self._stats_lock:
+            self.frames += self._frames_per_dispatch
+            self.super_steps += 1
+        try:
+            flat.copy_to_host_async()
+        except Exception:
+            pass  # no async copies on this backend: harvest pays the trip
+        return train_state, flat
+
+    def harvest(self, flat) -> np.ndarray:
+        """Fetch one dispatch's result vector — the loop's ONLY recurring
+        device→host crossing — and fold its deltas into the host
+        counters.  Returns the k inner-step losses."""
+        HOST_TRANSFERS.count("anakin.result_fetch")
+        v = np.asarray(jax.device_get(flat))
+        k = self.cfg.superstep_k
+        losses = v[:k]
+        assert np.isfinite(losses).all(), (
+            f"non-finite loss in anakin super-step: {losses}")
+        self._absorb(v[k:])
+        with self._stats_lock:
+            self.training_steps += k
+            self._interval_loss += float(losses.sum())
+        return losses
+
+    def _absorb(self, s: np.ndarray) -> None:
+        d = dict(zip(STATS_FIELDS, s.tolist()))
+        with self._stats_lock:
+            self.env_steps += int(d["env_steps"])
+            self.fill = int(d["fill"])
+            self.blocks += int(d["blocks"])
+            self.episodes_total += int(d["episodes"])
+            self.reward_total += float(d["reward_sum"])
+            self._interval_episodes += int(d["episodes"])
+            self._interval_reward += float(d["reward_sum"])
+
+    @property
+    def ready(self) -> bool:
+        return self.fill >= self.cfg.learning_starts
+
+    def stats(self) -> Dict[str, float]:
+        """ReplayBuffer.stats()-shaped snapshot for the log loop (the
+        interval accumulators reset on read, like the buffer's)."""
+        with self._stats_lock:
+            out = dict(size=self.fill, env_steps=self.env_steps,
+                       training_steps=self.training_steps,
+                       num_episodes=self._interval_episodes,
+                       episode_reward=self._interval_reward,
+                       sum_loss=self._interval_loss,
+                       frames=self.frames, super_steps=self.super_steps,
+                       blocks=self.blocks,
+                       episodes_total=self.episodes_total)
+            self._interval_episodes = 0
+            self._interval_reward = 0.0
+            self._interval_loss = 0.0
+        return out
+
+    # ----------------------------------------------------------- snapshot
+    _COUNTER_FIELDS = ("env_steps", "fill", "frames", "super_steps",
+                       "blocks", "episodes_total", "reward_total",
+                       "training_steps", "dispatch_no")
+
+    def _payload(self) -> Dict[str, np.ndarray]:
+        """Host copies of the ENTIRE on-device loop state: anakin carry
+        (env phase/t/keys, agent obs/LSTM carry, local buffers), ring
+        arrays, and the PER leaf/metadata state.  Call only with no
+        dispatch in flight (the driver drains its pipeline first)."""
+        HOST_TRANSFERS.count("anakin.snapshot_fetch")
+        arrays, prios, seq_meta, first = self._handles()
+        host = jax.device_get(dict(state=self.state, ring=arrays,
+                                   prios=prios, seq_meta=seq_meta,
+                                   first=first))
+        flat: Dict[str, np.ndarray] = {}
+        for k, v in host["state"].items():
+            flat[f"state_{k}"] = np.asarray(v)
+        for k, v in host["ring"].items():
+            flat[f"ring_{k}"] = np.asarray(v)
+        flat["per_prios"] = np.asarray(host["prios"])
+        flat["per_seq_meta"] = np.asarray(host["seq_meta"])
+        flat["per_first"] = np.asarray(host["first"])
+        return flat
+
+    def write_state(self, path: str) -> Dict[str, Any]:
+        """Serialise the full anakin loop state into ``path`` (the
+        ``Checkpointer.save_replay`` writer contract — same atomic
+        tmp-dir/rename machinery as host-ring replay snapshots).  Returns
+        the JSON-able meta ``read_state`` validates against."""
+        flat = self._payload()
+        with open(path, "wb") as f:  # file handle: savez must not append .npz
+            np.savez(f, **flat)
+        return dict(
+            kind="anakin",
+            layout=[[k, list(v.shape), v.dtype.name]
+                    for k, v in sorted(flat.items())],
+            counters={k: getattr(self, k) for k in self._COUNTER_FIELDS},
+        )
+
+    def read_state(self, path: str, meta: Dict[str, Any]) -> None:
+        """Restore the state :meth:`write_state` captured.  Raises
+        ``ValueError`` on a geometry/config mismatch (the caller warns and
+        resumes cold)."""
+        if meta.get("kind") != "anakin":
+            raise ValueError("snapshot is not an anakin loop snapshot")
+        with np.load(path) as z:
+            flat = {k: z[k] for k in z.files}
+        want = [[k, list(v.shape), v.dtype.name]
+                for k, v in sorted(flat.items())]
+        have = [[k, list(v.shape), np.dtype(v.dtype).name]
+                for k, v in sorted(self._payload_template().items())]
+        if want != have:
+            raise ValueError(
+                "anakin snapshot layout mismatch — written under a "
+                "different config geometry; resuming cold")
+        self.state = {k[len("state_"):]: jnp.asarray(v)
+                      for k, v in flat.items() if k.startswith("state_")}
+        self.ring.arrays = {k[len("ring_"):]: jnp.asarray(v)
+                            for k, v in flat.items()
+                            if k.startswith("ring_")}
+        self.ring.put_prios(jnp.asarray(flat["per_prios"]))
+        self.ring.put_per_meta(jnp.asarray(flat["per_seq_meta"]),
+                               jnp.asarray(flat["per_first"]))
+        c = meta.get("counters", {})
+        for k in self._COUNTER_FIELDS:
+            if k in c:
+                setattr(self, k, type(getattr(self, k))(c[k]))
+
+    def _payload_template(self) -> Dict[str, Any]:
+        """Shape/dtype template of :meth:`_payload` WITHOUT fetching
+        device bytes (for layout validation before overwriting state)."""
+        arrays, prios, seq_meta, first = self._handles()
+        out: Dict[str, Any] = {}
+        for k, v in self.state.items():
+            out[f"state_{k}"] = jax.ShapeDtypeStruct(jnp.shape(v), v.dtype)
+        for k, v in arrays.items():
+            out[f"ring_{k}"] = jax.ShapeDtypeStruct(jnp.shape(v), v.dtype)
+        out["per_prios"] = jax.ShapeDtypeStruct(jnp.shape(prios),
+                                                prios.dtype)
+        out["per_seq_meta"] = jax.ShapeDtypeStruct(jnp.shape(seq_meta),
+                                                   seq_meta.dtype)
+        out["per_first"] = jax.ShapeDtypeStruct(jnp.shape(first),
+                                                first.dtype)
+        return out
+
+
+def run_anakin_loop(learner: Any, plane: AnakinPlane,
+                    stop: Optional[Any] = None, tracer: Optional[Any] = None,
+                    max_steps: Optional[int] = None,
+                    snapshot_fn: Optional[Any] = None) -> Dict[str, Any]:
+    """The anakin drivetrain: warm-up rollouts until the in-graph ring
+    fill passes ``learning_starts``, then pipelined fused super-steps with
+    the publish/save cadences of the other device drivetrains
+    (:meth:`Learner._superstep_loop` semantics; updates advance by k per
+    dispatch).  ``snapshot_fn(step)``, when given, is called at
+    ``cfg.replay_snapshot_interval``-second crossings ON this thread (the
+    dispatch thread owns the device handles, so periodic full-state
+    snapshots cannot race a dispatch).  Returns summary metrics incl. the
+    full per-update loss curve."""
+    import time
+
+    cfg = learner.cfg
+    if tracer is None:
+        from r2d2_tpu.utils.trace import Tracer
+        tracer = Tracer()
+    k = cfg.superstep_k
+    t0 = time.time()
+    updates = learner.num_updates
+    target = cfg.training_steps if max_steps is None else updates + max_steps
+    losses_all: list = []
+    pending: list = []
+    last_snap = time.time()
+
+    def harvest_one() -> None:
+        losses_all.extend(plane.harvest(pending.pop(0)).tolist())
+
+    while updates < target:
+        if stop is not None and stop():
+            break
+        if not plane.ready:
+            with tracer.span("anakin.rollout_dispatch"):
+                plane.rollout_step(learner.state.params)
+            continue
+        with tracer.span("learner.step_dispatch"):
+            learner.state, flat = plane.dispatch(learner.state)
+        pending.append(flat)
+        while len(pending) > cfg.superstep_pipeline:
+            with tracer.span("learner.result_sync"):
+                harvest_one()
+
+        prev, updates = updates, updates + k
+        if (learner.param_store is not None
+                and updates // cfg.weight_publish_interval
+                > prev // cfg.weight_publish_interval):
+            learner._publish()
+        if (learner.checkpointer is not None
+                and updates // cfg.save_interval
+                > prev // cfg.save_interval):
+            learner.env_steps = plane.env_steps
+            learner._save(updates, t0)
+        if (snapshot_fn is not None and cfg.replay_snapshot_interval > 0
+                and time.time() - last_snap > cfg.replay_snapshot_interval):
+            while pending:  # snapshots need no dispatch in flight
+                harvest_one()
+            snapshot_fn(updates)
+            last_snap = time.time()
+    while pending:
+        harvest_one()
+
+    learner.env_steps = plane.env_steps
+    metrics = learner._finish_device_run(losses_all[-100:], t0)
+    metrics["losses"] = losses_all
+    metrics["env_steps"] = plane.env_steps
+    metrics["anakin_frames"] = plane.frames
+    metrics["anakin_super_steps"] = plane.super_steps
+    metrics["episodes"] = plane.episodes_total
+    metrics["mean_episode_return"] = (
+        plane.reward_total / plane.episodes_total
+        if plane.episodes_total else float("nan"))
+    return metrics

@@ -383,3 +383,14 @@ class DeviceRing:
     def per_meta(self) -> Dict[str, jnp.ndarray]:
         """Read-only sampling metadata handles for a dispatch."""
         return dict(seq_meta=self._per_seq_meta, first=self._per_first)
+
+    def put_per_meta(self, seq_meta: jnp.ndarray,
+                     first: jnp.ndarray) -> None:
+        """Store back PER sampling-metadata handles returned by a dispatch
+        that DONATED them.  Host-side commits (:meth:`commit_per`) write
+        these in place, but the anakin fused loop (learner/anakin.py)
+        writes them in-graph instead — its dispatches consume the current
+        handles and this stores the returned generation, the same
+        discipline as :meth:`take_prios`/:meth:`put_prios`."""
+        self._per_seq_meta = seq_meta
+        self._per_first = first

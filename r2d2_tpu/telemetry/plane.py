@@ -152,6 +152,19 @@ class Telemetry:
                               svc.get("last_batch_lanes", 0))
                 reg.set_gauge("serve.param_version",
                               svc.get("param_version", 0))
+        # anakin fused-loop surface (train._train_anakin's log loop): the
+        # transport is single-process by construction, so its counters
+        # publish straight through the registry — no shm slab involved
+        an = entry.get("anakin")
+        if an:
+            reg.counter_max("anakin.super_steps", an.get("super_steps", 0))
+            reg.counter_max("anakin.frames", an.get("frames", 0))
+            reg.set_gauge("anakin.frames_per_sec",
+                          an.get("frames_per_sec", 0.0))
+            reg.counter_max("actor.env_steps", entry.get("env_steps", 0))
+            reg.counter_max("actor.blocks_produced", an.get("blocks", 0))
+            reg.counter_max("actor.episodes", an.get("episodes_total", 0))
+            reg.set_gauge("anakin.ring_fill", entry.get("buffer_size", 0))
         # the runtime guard surfaces (utils/trace.py process-wide views)
         from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
 

@@ -360,6 +360,256 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
 
 # --------------------------------------------------------------------------
+# anakin trainer: ONE compiled on-device program (learner/anakin.py)
+# --------------------------------------------------------------------------
+
+def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
+                  resume: bool = False, use_mesh: bool = False,
+                  max_wall_seconds: Optional[float] = None,
+                  verbose: bool = True,
+                  log_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+                  tracer: Optional[Tracer] = None,
+                  profile_dir: Optional[str] = None) -> Dict[str, Any]:
+    """``actor_transport="anakin"``: the whole training loop — pure-JAX
+    batched env, in-graph actor, in-graph replay writes, train steps —
+    is one jitted program (the Podracer "Anakin" architecture,
+    learner/anakin.py).  The host dispatches it and reads a (k + 5)-float
+    result vector back; there are no actor/sample/priority threads at all
+    (the transport is single-process by construction).
+
+    What carries over from the threaded fabric: the telemetry plane
+    (registry + JSONL run log + HTTP exporter + the shared console line),
+    SIGTERM/SIGINT drain-then-save with full-state resume (the snapshot
+    holds the ENTIRE on-device loop state: ring, PER leaves, env
+    phase/RNGs, agent LSTM carry, local buffers — ``--resume`` continues
+    bit-exact), the learner heartbeat watchdog, and checkpoint cadences.
+    Not supported in this mode (documented in docs/OPERATIONS.md): chaos
+    injection (no fleet/shm fault sites exist), meshes (single-device
+    v1), and custom env factories (the env must be jittable; v1 ships the
+    fake env — any future jittable env plugs in at
+    ``envs/anakin.AnakinFakeEnv``'s four-method surface).
+    """
+    from r2d2_tpu.learner.anakin import AnakinPlane, run_anakin_loop
+    from r2d2_tpu.replay.device_ring import DeviceRing
+
+    if use_mesh:
+        import warnings
+
+        warnings.warn("anakin transport is single-device (v1); --mesh is "
+                      "ignored", stacklevel=2)
+    if cfg.game_name != "Fake":
+        import warnings
+
+        warnings.warn(
+            f"anakin transport needs a jittable env; substituting the "
+            f"pure-JAX fake env for {cfg.game_name!r}", stacklevel=2)
+    # the fused program IS device replay with in-graph PER — flip the
+    # flags so the ring/PER state and the train-step composition build
+    # exactly as the in_graph_per drivetrain's (effective-config pattern)
+    cfg = cfg.replace(device_replay=True, in_graph_per=True)
+    action_dim = 4  # the anakin fake env's action set (envs/anakin.py)
+    net = create_network(cfg, action_dim)
+    params = init_params(cfg, net, jax.random.PRNGKey(cfg.seed))
+    state = create_train_state(cfg, params)
+    checkpointer = (Checkpointer(checkpoint_dir, keep=cfg.keep_checkpoints)
+                    if checkpoint_dir else None)
+    start_env_steps, start_minutes = 0, 0.0
+    if (checkpointer is not None and resume
+            and checkpointer.latest_step() is not None):
+        from r2d2_tpu.checkpoint import check_arch_compat
+
+        check_arch_compat(cfg, checkpointer.peek_meta())
+        state, meta = checkpointer.restore(jax.device_get(state))
+        start_env_steps = int(meta.get("env_steps", 0))
+        start_minutes = float(meta.get("minutes", 0.0))
+
+    ring = DeviceRing(cfg, action_dim)
+    # no ParamStore: the fused loop acts on the CURRENT params in-graph
+    # and nothing else consumes published snapshots in this mode (no
+    # fleets, pump, or inference service) — publishing would just run a
+    # jitted whole-tree param copy per cadence for no reader
+    learner = Learner(cfg, net, state, checkpointer=checkpointer,
+                      start_env_steps=start_env_steps,
+                      start_minutes=start_minutes)
+    plane = AnakinPlane(cfg, net, action_dim, ring,
+                        start_env_steps=start_env_steps)
+
+    restored_anakin = False
+    if checkpointer is not None and resume:
+        rep = checkpointer.restore_replay()
+        if rep is not None:
+            import warnings
+
+            meta_r, ring_path, _ = rep
+            if meta_r.get("kind") == "anakin":
+                try:
+                    plane.read_state(ring_path, meta_r)
+                    restored_anakin = True
+                except (ValueError, OSError) as e:
+                    warnings.warn(f"anakin snapshot not restored: {e}",
+                                  stacklevel=2)
+            else:
+                warnings.warn(
+                    "a replay snapshot exists but it is not an anakin "
+                    "loop snapshot (different transport) — resuming with "
+                    "a cold ring", stacklevel=2)
+
+    tracer = tracer or Tracer()
+    supervisor = Supervisor(max_restarts=3)
+    telemetry = Telemetry(cfg, checkpoint_dir)
+    stop_event = threading.Event()
+    deadline = (time.time() + max_wall_seconds) if max_wall_seconds else None
+
+    def stop() -> bool:
+        return (stop_event.is_set() or supervisor.any_failed
+                or (deadline is not None and time.time() > deadline))
+
+    prev_handlers: Dict[int, Any] = {}
+    if threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            log.warning("signal %d: draining the anakin loop, then saving "
+                        "full on-device state", signum)
+            stop_event.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(sig, _on_signal)
+            except (ValueError, OSError):
+                pass
+
+    heartbeat = Heartbeat()
+    stall = {"stalled": False}
+
+    def learner_stop() -> bool:
+        heartbeat.beat()
+        return stop()
+
+    logs: "collections.deque" = collections.deque(maxlen=cfg.log_history_cap)
+
+    def healthz() -> Dict[str, Any]:
+        age = heartbeat.age()
+        stale = (cfg.learner_stall_timeout > 0
+                 and age > cfg.learner_stall_timeout)
+        return dict(ok=not (supervisor.any_failed or stall["stalled"]
+                            or stale),
+                    learner_heartbeat_age=age,
+                    learner_stalled=stall["stalled"] or stale,
+                    threads=supervisor.health())
+
+    def log_loop():
+        last_steps, last_frames, last_time = 0, 0, time.time()
+        while not stop():
+            time.sleep(min(cfg.log_interval, 0.5))
+            now = time.time()
+            if now - last_time < cfg.log_interval:
+                continue
+            s = plane.stats()
+            dt = now - last_time
+            entry = dict(
+                time=now, buffer_size=s["size"], env_steps=s["env_steps"],
+                training_steps=s["training_steps"],
+                updates_per_sec=(s["training_steps"] - last_steps) / dt,
+                mean_episode_return=(s["episode_reward"] / s["num_episodes"]
+                                     if s["num_episodes"] else float("nan")),
+                mean_loss=(s["sum_loss"]
+                           / max(1, s["training_steps"] - last_steps)),
+                interval_episodes=s["num_episodes"],
+                trace=tracer.snapshot(),
+                health=supervisor.health(),
+                learner_heartbeat_age=heartbeat.age(),
+                telemetry_port=telemetry.port,
+                anakin=dict(super_steps=s["super_steps"],
+                            frames=s["frames"],
+                            frames_per_sec=(s["frames"] - last_frames) / dt,
+                            blocks=s["blocks"],
+                            episodes_total=s["episodes_total"]),
+            )
+            logs.append(entry)
+            telemetry.record(entry)
+            if log_sink is not None:
+                log_sink(entry)
+            if verbose:
+                print(format_entry(entry), flush=True)
+            last_steps, last_frames, last_time = (
+                s["training_steps"], s["frames"], now)
+
+    def learner_watch():
+        poll = min(0.05, cfg.learner_stall_timeout / 4)
+        while not stop():
+            time.sleep(poll)
+            if heartbeat.age() > cfg.learner_stall_timeout:
+                stall["stalled"] = True
+                log.error("anakin loop heartbeat stale for %.1fs (budget "
+                          "%.1fs): declaring a stall and stopping",
+                          heartbeat.age(), cfg.learner_stall_timeout)
+                stop_event.set()
+                return
+
+    want_full_save = checkpointer is not None and cfg.replay_snapshot
+
+    def save_anakin_snapshot(step: int) -> None:
+        """Persist the ENTIRE on-device loop state (ring + PER + env/agent
+        carry + counters) through the atomic replay-snapshot machinery —
+        what ``--resume`` restores via ``plane.read_state``."""
+        try:
+            checkpointer.save_replay(step, plane.write_state)
+        except Exception as e:  # never fail the run over snapshot I/O
+            log.warning("anakin full-state snapshot failed: %s", e)
+
+    loops = [("log", log_loop)]
+    if cfg.learner_stall_timeout > 0:
+        loops.append(("learner_watch", learner_watch))
+    exporter = telemetry.serve(healthz)
+    if exporter is not None:
+        def telemetry_loop():
+            while not exporter.closed:
+                try:
+                    exporter.handle_once()
+                except (OSError, ValueError):
+                    return
+
+        loops.append(("telemetry", telemetry_loop))
+
+    try:
+        try:
+            for name, loop in loops:
+                supervisor.start(name, loop)
+            with device_profile(profile_dir):
+                metrics = run_anakin_loop(
+                    learner, plane, stop=learner_stop, tracer=tracer,
+                    snapshot_fn=(save_anakin_snapshot if want_full_save
+                                 else None))
+        finally:
+            stop_event.set()
+            telemetry.close_exporter()
+            supervisor.join_all(timeout=5.0)
+
+        # drain-then-save epilogue: the learner state was saved by
+        # run_anakin_loop's final _save; persist the on-device loop state
+        # next to it so --resume continues warm (ring, RNGs, env phase,
+        # LSTM carry — no cold restart)
+        if want_full_save:
+            save_anakin_snapshot(learner.num_updates)
+
+        metrics.update(buffer_size=plane.fill, logs=list(logs),
+                       buffer_training_steps=plane.training_steps,
+                       final_params=learner.state.params,
+                       restored_replay=restored_anakin,
+                       learner_stalled=stall["stalled"],
+                       trace=tracer.snapshot(), health=supervisor.health(),
+                       telemetry_port=telemetry.port,
+                       fabric_failed=supervisor.any_failed)
+        return metrics
+    finally:
+        telemetry.close()
+        for sig, handler in prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+
+
+# --------------------------------------------------------------------------
 # threaded fabric trainer (the reference's process topology, thread-native)
 # --------------------------------------------------------------------------
 
@@ -418,6 +668,23 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     a ``cfg.log_history_cap`` ring — the JSONL file is the durable
     record.
     """
+    if cfg.actor_transport == "anakin":
+        # the Podracer fused on-device loop (learner/anakin.py): env,
+        # actor, replay and learner are ONE jitted program — none of the
+        # thread/process fabric below applies
+        if env_factory is not _default_env_factory:
+            import warnings
+
+            warnings.warn(
+                "anakin transport ignores env_factory — the env must be "
+                "jittable and v1 ships only the pure-JAX fake env "
+                "(envs/anakin.py; episode length via "
+                "cfg.anakin_episode_len)", stacklevel=2)
+        return _train_anakin(cfg, checkpoint_dir=checkpoint_dir,
+                             resume=resume, use_mesh=use_mesh,
+                             max_wall_seconds=max_wall_seconds,
+                             verbose=verbose, log_sink=log_sink,
+                             tracer=tracer, profile_dir=profile_dir)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     cfg = sys["cfg"]  # the EFFECTIVE config (degrade paths flip flags)
     actors: List[VectorActor] = sys["actors"]

@@ -160,11 +160,18 @@ def test_truncated_replay_snapshot_never_selected(tmp_path):
 def test_learner_freeze_detected_by_heartbeat_watchdog():
     """Chaos freezes the learner thread mid-run: the heartbeat watchdog
     must declare the stall within its budget and stop the fabric instead
-    of letting the actors feed a wedged learner forever."""
+    of letting the actors feed a wedged learner forever.
+
+    Deflaked (r08): ``at=1`` fires the freeze on the learner's FIRST
+    stop poll — before the first jitted-step compile can open a
+    beat-free window — and the budget sits above worst-case loaded-host
+    compile, per the OPERATIONS guidance the old 0.4s budget violated
+    (under full-suite load the watchdog tripped on compile before the
+    ``at=3`` freeze ever fired, leaving freeze_learner == 0)."""
     cfg = make_test_config(game_name="Fake", training_steps=500,
                            log_interval=0.2,
-                           chaos_spec="freeze_learner:at=3,dur=1.5",
-                           learner_stall_timeout=0.4)
+                           chaos_spec="freeze_learner:at=1,dur=6",
+                           learner_stall_timeout=2.5)
     t0 = time.time()
     from r2d2_tpu.train import train
 

@@ -1,0 +1,212 @@
+"""Anakin vs thread-transport A/B (ISSUE 6 perf evidence).
+
+Matched-configuration cells: the SAME Config (network, windows, replay
+geometry, lane count, in-graph PER) trained through (a) the threaded
+fabric — host env stepping + device-replay in-graph-PER learner, the
+fastest pre-anakin path — and (b) the anakin fused on-device loop.  Both
+run ``train()`` for a fixed wall budget; steady-state rates are computed
+from the log loop's interval deltas (compile time and warm-up excluded by
+dropping entries before training starts moving).
+
+The thread cells step the NUMPY fake env at ``episode_len`` matching
+``anakin_episode_len``, so a "frame" is the same unit of work in both
+transports.  Note the honest asymmetry: anakin couples env stepping to
+the update cadence (``anakin_env_steps_per_update`` per optimizer step),
+so its frames/s is updates/s × E × lanes by construction — the A/B's
+headline number is therefore **updates/s at matched learning
+configuration**, with frames/s reported alongside.
+
+Writes artifacts/r08/ANAKIN_AB_r08.json + docs/perf/ANAKIN_r08.md, and a
+bounded accelerator-backend probe record (standing ROADMAP side-quest:
+re-run real-chip cells when a backend is reachable; record the failed
+probe otherwise, as in BENCH_r05).
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from r2d2_tpu.config import test_config  # noqa: E402
+from r2d2_tpu.envs import FakeAtariEnv  # noqa: E402
+from r2d2_tpu.train import train  # noqa: E402
+
+PATH = "artifacts/r08/ANAKIN_AB_r08.json"
+DOC = "docs/perf/ANAKIN_r08.md"
+PROBE = "artifacts/r08/PROBE_r08.json"
+WALL = 30.0          # seconds per cell (compile + warm-up + steady state)
+EPISODE_LEN = 32
+
+
+def make_cfg(transport: str, lanes: int):
+    return test_config(
+        game_name="Fake", actor_transport=transport, num_actors=lanes,
+        device_replay=True, in_graph_per=True, superstep_k=4,
+        anakin_episode_len=EPISODE_LEN, training_steps=10 ** 9,
+        log_interval=1.0, save_interval=10 ** 9)
+
+
+def steady_rates(logs) -> dict:
+    """updates/s and env-frames/s from the last half of the MOVING log
+    entries (training_steps increasing), excluding compile/warm-up."""
+    moving = [e for e in logs if e["training_steps"] > 0]
+    if len(moving) < 3:
+        return dict(updates_per_sec=float("nan"),
+                    frames_per_sec=float("nan"), entries=len(moving))
+    tail = moving[len(moving) // 2:]
+    dt = tail[-1]["time"] - tail[0]["time"]
+    dup = tail[-1]["training_steps"] - tail[0]["training_steps"]
+    # thread entries carry env_steps (learning-step accounting, = env
+    # transitions up to in-flight lag) — the same unit anakin reports
+    dfr = tail[-1]["env_steps"] - tail[0]["env_steps"]
+    return dict(updates_per_sec=round(dup / dt, 2),
+                frames_per_sec=round(dfr / dt, 2), entries=len(moving))
+
+
+def cell(transport: str, lanes: int) -> dict:
+    cfg = make_cfg(transport, lanes)
+    if transport == "anakin":
+        m = train(cfg, verbose=False, max_wall_seconds=WALL)
+    else:
+        def envf(c, seed):
+            return FakeAtariEnv(obs_shape=c.obs_shape, action_dim=4,
+                                seed=seed, episode_len=EPISODE_LEN)
+
+        m = train(cfg, env_factory=envf, verbose=False,
+                  max_wall_seconds=WALL)
+    r = steady_rates(m["logs"])
+    out = dict(transport=transport, lanes=lanes,
+               backend=jax.default_backend(),
+               num_updates=int(m["num_updates"]),
+               env_steps=int(m["env_steps"]), **r)
+    print(f"transport={transport} lanes={lanes}: "
+          f"{r['updates_per_sec']} updates/s, "
+          f"{r['frames_per_sec']} frames/s "
+          f"({m['num_updates']} updates total)", flush=True)
+    return out
+
+
+def probe_accelerator() -> dict:
+    """Bounded probe for a non-CPU backend (the tunneled-chip claim):
+    one subprocess attempt with a hard timeout, recorded either way."""
+    now = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+    code = ("import os,jax,json;"
+            "print(json.dumps([d.platform for d in jax.devices()]))")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=60,
+                           capture_output=True, text=True, env=env)
+        platforms = json.loads(p.stdout.strip() or "[]") if p.returncode == 0 \
+            else []
+    except (subprocess.TimeoutExpired, json.JSONDecodeError):
+        platforms = []
+    reachable = any(pl != "cpu" for pl in platforms)
+    if reachable:
+        note = "re-run tools/measure_tpu.py + bench.py cells"
+    elif platforms:
+        note = ("only CPU platforms visible — real-chip anakin cells "
+                "remain a standing side-quest, as in BENCH_r05")
+    else:
+        note = ("backend probe failed to initialise any platform "
+                "(timed out or errored — tunneled chip claim absent or "
+                "wedged); real-chip anakin cells remain a standing "
+                "side-quest, as in BENCH_r05")
+    return dict(probed_at=now, platforms=platforms,
+                accelerator_reachable=reachable, note=note)
+
+
+def render_doc(data: dict) -> str:
+    lines = [
+        "# Anakin fused on-device loop vs threaded fabric — r08",
+        "",
+        f"Host: {data['host_cpus']} CPUs, backend `{data['backend']}`; "
+        f"matched config per cell (mlp test-scale net, in-graph PER, "
+        f"k=4, episode_len={EPISODE_LEN}, {WALL:.0f}s wall each, "
+        "steady-state rates from log-interval deltas).",
+        "",
+        "`thread` is the fastest pre-anakin path (host env stepping + "
+        "device-replay in-graph-PER learner).  `anakin` fuses env-step → "
+        "act → block-cut → ring-write → train-step into ONE jitted "
+        "program (learner/anakin.py); its frames/s is coupled to "
+        "updates/s by `anakin_env_steps_per_update` — the headline "
+        "number is updates/s at matched learning configuration.",
+        "",
+        "| transport | lanes | updates/s | env frames/s |",
+        "|---|---|---|---|",
+    ]
+    for c in data["cells"]:
+        lines.append(f"| {c['transport']} | {c['lanes']} | "
+                     f"{c['updates_per_sec']:,} | "
+                     f"{c['frames_per_sec']:,} |")
+    lines += ["", "## anakin vs thread (same lane count)", ""]
+    by = {(c["transport"], c["lanes"]): c for c in data["cells"]}
+    for lanes in sorted({c["lanes"] for c in data["cells"]}):
+        a, t = by.get(("anakin", lanes)), by.get(("thread", lanes))
+        if a and t and t["updates_per_sec"] == t["updates_per_sec"]:
+            lines.append(
+                f"- {lanes} lanes: anakin/thread = "
+                f"**{a['updates_per_sec'] / t['updates_per_sec']:.2f}x** "
+                f"updates/s ({a['updates_per_sec']:,} vs "
+                f"{t['updates_per_sec']:,})")
+    pr = data["probe"]
+    lines += [
+        "",
+        "Host-transfer discipline: the anakin e2e asserts ONE "
+        "device→host fetch per super-step (the (k+5)-float result "
+        "vector), independent of lanes/k/steps — "
+        "tests/test_anakin.py::test_anakin_host_transfers_constant_per_"
+        "superstep.",
+        "",
+        "## accelerator probe (standing side-quest)",
+        "",
+        f"- probed_at: {pr['probed_at']}",
+        f"- platforms visible: {pr['platforms']}",
+        f"- reachable: {pr['accelerator_reachable']} — {pr['note']}",
+        "",
+        "Reading: on CPU the fused loop removes the Python actor loop, "
+        "the queue handoffs, and every per-step host↔device crossing; "
+        "the remaining gap to the raw-speed ceiling is device compute. "
+        "On a real accelerator the same program runs without ANY "
+        "interconnect on the hot path (the thread path pays it per "
+        "block and per index bundle), so the CPU ratio is the floor, "
+        "not the ceiling.",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def main() -> int:
+    cells = []
+    for lanes in (2, 8):
+        cells.append(cell("thread", lanes))
+        cells.append(cell("anakin", lanes))
+    data = dict(
+        kind="anakin_ab_r08",
+        recorded_at=datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+        host_cpus=os.cpu_count(), backend=jax.default_backend(),
+        wall_seconds_per_cell=WALL, episode_len=EPISODE_LEN,
+        cells=cells, probe=probe_accelerator(),
+    )
+    os.makedirs(os.path.dirname(PATH), exist_ok=True)
+    with open(PATH, "w") as f:
+        json.dump(data, f, indent=1)
+    with open(PROBE, "w") as f:
+        json.dump(data["probe"], f, indent=1)
+    os.makedirs(os.path.dirname(DOC), exist_ok=True)
+    with open(DOC, "w") as f:
+        f.write(render_doc(data))
+    print(f"wrote {PATH}, {PROBE} and {DOC}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
