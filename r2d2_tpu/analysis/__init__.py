@@ -5,6 +5,8 @@ Rule families (see docs/ANALYSIS.md for the full reference):
 - ``jit-purity``           host effects inside jit-traced code
 - ``config-integrity``     cfg.X resolution + field liveness/docs
 - ``thread-discipline``    Supervisor-managed threads, locked shared writes
+- ``bounded-wait``         supervised loops / thread targets never block
+  without a timeout (get/wait/join need timeout=)
 - ``wire-format``          shm slot layout / CRC single-sourced in replay/block
 - ``telemetry-discipline`` metric names are registered literals, not
   f-strings (the variable part belongs in a label)
@@ -27,6 +29,7 @@ from r2d2_tpu.analysis.core import (  # noqa: F401
     run_analysis,
 )
 from r2d2_tpu.analysis import (  # noqa: F401  (import = rule registration)
+    bounded_wait,
     config_integrity,
     jit_purity,
     telemetry_discipline,

@@ -143,7 +143,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     pt.add_argument("--chaos", default=None, metavar="SPEC",
                     help="fault-injection drill spec (utils/chaos.py), "
                          "e.g. 'kill_fleet:every=500;garble_block:p=0.01' "
-                         "— overrides cfg.chaos_spec")
+                         "or 'freeze_service:at=40,dur=5' — overrides "
+                         "cfg.chaos_spec")
+    pt.add_argument("--act-response-timeout", type=float, default=None,
+                    metavar="SECS",
+                    help="serve mode: per-attempt act-RPC deadline before "
+                         "a fleet retries and then degrades to local "
+                         "inference (circuit breaker, "
+                         "utils/resilience.py); overrides "
+                         "cfg.act_response_timeout (must be > 0)")
     pt.add_argument("--mesh", action="store_true",
                     help="data-parallel learner over all visible devices")
     pt.add_argument("--distributed", action="store_true",
@@ -208,6 +216,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cfg = cfg.replace(chaos_spec=args.chaos)
             if args.telemetry_port is not None:
                 cfg = cfg.replace(telemetry_port=args.telemetry_port)
+            if args.act_response_timeout is not None:
+                cfg = cfg.replace(
+                    act_response_timeout=args.act_response_timeout)
         except ValueError as e:
             parser.error(str(e))
         if args.sync and args.max_wall_seconds is not None:

@@ -165,6 +165,19 @@ class Config:
                                       # dispatching, so F singleton
                                       # batches coalesce into one
                                       # cross-fleet batch (0 disables)
+    act_response_timeout: float = 60.0  # serve mode: per-attempt deadline
+                                      # a fleet waits on one act RPC
+                                      # before treating the service as
+                                      # unresponsive (bounded retries,
+                                      # then its circuit breaker opens
+                                      # and the fleet degrades to local
+                                      # inference on its last pumped
+                                      # weights — utils/resilience.py;
+                                      # must be > 0 and comfortably above
+                                      # the service's worst-case act
+                                      # compile; the old behavior was a
+                                      # hardcoded 600 s then a fleet-
+                                      # killing RuntimeError)
     device_replay: bool = False       # replay data lives in HBM; batches
                                       # are gathered in-graph (device_ring)
     device_ring_layout: str = "auto"  # "replicated" (full ring per device)
@@ -235,6 +248,17 @@ class Config:
                                       # (utils/chaos.py), e.g.
                                       # "kill_fleet:every=500;garble_block:p=0.01"
                                       # — drills/soaks only; "" disables
+    dispatch_deadline: float = 0.0    # anakin transport: >0 bounds one
+                                      # fused-dispatch harvest to this
+                                      # many seconds; a dispatch that
+                                      # blows the budget (wedged device,
+                                      # chaos wedge_dispatch drill) makes
+                                      # the loop snapshot its full state
+                                      # and abort cleanly instead of
+                                      # training on through a flaky
+                                      # device (0 disables — the
+                                      # heartbeat watchdog + periodic
+                                      # snapshots remain the backstop)
     # --- telemetry (r2d2_tpu/telemetry, docs/OBSERVABILITY.md) ------------
     telemetry_port: int = 0           # HTTP scrape endpoint (/metrics
                                       # Prometheus text, /healthz,
@@ -357,6 +381,13 @@ class Config:
                 "(expected 'float32' or 'bfloat16')")
         if self.inference_batch_window < 0:
             raise ValueError("inference_batch_window must be >= 0")
+        if self.act_response_timeout <= 0:
+            raise ValueError(
+                "act_response_timeout must be > 0 (the act RPC deadline "
+                "is what keeps a frozen service from wedging a fleet "
+                "forever — there is no unbounded mode)")
+        if self.dispatch_deadline < 0:
+            raise ValueError("dispatch_deadline must be >= 0 (0 disables)")
         if self.superstep_k < 1:
             raise ValueError("superstep_k must be >= 1")
         if self.superstep_pipeline < 0:

@@ -50,6 +50,7 @@ pure scatter.
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Dict, Optional
 
@@ -68,7 +69,10 @@ from r2d2_tpu.learner.step import (
 from r2d2_tpu.models.network import R2D2Network
 from r2d2_tpu.replay.device_ring import gather_batch
 from r2d2_tpu.utils.math import epsilon_ladder
+from r2d2_tpu.utils.resilience import Deadline
 from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
+
+log = logging.getLogger(__name__)
 
 # host-facing stats appended to the losses in the per-dispatch result
 # vector, in this order (all float32; the deltas are per-dispatch)
@@ -798,7 +802,8 @@ class AnakinPlane:
 def run_anakin_loop(learner: Any, plane: AnakinPlane,
                     stop: Optional[Any] = None, tracer: Optional[Any] = None,
                     max_steps: Optional[int] = None,
-                    snapshot_fn: Optional[Any] = None) -> Dict[str, Any]:
+                    snapshot_fn: Optional[Any] = None,
+                    chaos: Optional[Any] = None) -> Dict[str, Any]:
     """The anakin drivetrain: warm-up rollouts until the in-graph ring
     fill passes ``learning_starts``, then pipelined fused super-steps with
     the publish/save cadences of the other device drivetrains
@@ -807,7 +812,28 @@ def run_anakin_loop(learner: Any, plane: AnakinPlane,
     ``cfg.replay_snapshot_interval``-second crossings ON this thread (the
     dispatch thread owns the device handles, so periodic full-state
     snapshots cannot race a dispatch).  Returns summary metrics incl. the
-    full per-update loss curve."""
+    full per-update loss curve.
+
+    ``cfg.dispatch_deadline`` (> 0) bounds each harvest — the loop's one
+    blocking device wait — by fetching on a helper thread with a bounded
+    join, so even a device wait that NEVER returns cannot hang the loop.
+    Two wedge grades, both ending in a clean abort
+    (``metrics["dispatch_wedged"]``) instead of hammering a flaky device
+    or hanging forever — the Podracer stance: preemption/failure is
+    routine, so park the state where ``--resume`` finds it and get out
+    of the way:
+
+    - *slow* (the fetch completed but blew the budget — it gets one
+      extra budget of grace to come back): drain the pipeline, write a
+      full resumable snapshot via ``snapshot_fn``, abort;
+    - *hard* (the fetch did not return within twice the budget; the
+      chaos ``wedge_dispatch`` site drills this by stalling the fetch
+      thread past the grace window):
+      abandon the fetch thread — a device wait cannot be interrupted,
+      only walked away from — skip the drain (it would block on the same
+      device), attempt the snapshot on a BOUNDED helper thread, and
+      abort; if even the snapshot attempt times out, the last periodic
+      snapshot remains the resume point."""
     import time
 
     cfg = learner.cfg
@@ -821,11 +847,80 @@ def run_anakin_loop(learner: Any, plane: AnakinPlane,
     losses_all: list = []
     pending: list = []
     last_snap = time.time()
+    wedged = False
+    hard_wedged = False
+    abandoned = threading.Event()   # set when a hard wedge walks away
 
     def harvest_one() -> None:
-        losses_all.extend(plane.harvest(pending.pop(0)).tolist())
+        nonlocal wedged, hard_wedged
+        flat = pending.pop(0)
 
-    while updates < target:
+        def fetch():
+            # the chaos stall lives INSIDE the fetch so the drill
+            # exercises the real hard-wedge path: a device wait that
+            # does not come back within the budget
+            if chaos is not None:
+                stall = chaos.dispatch_wedge_seconds()
+                if stall > 0:
+                    log.warning("chaos: wedging the anakin dispatch "
+                                "harvest for %.1fs", stall)
+                    time.sleep(stall)
+            if abandoned.is_set():
+                # the loop declared a hard wedge and may be mid-snapshot:
+                # a late harvest would fold this dispatch's counters into
+                # state the snapshot thread is reading (while its losses
+                # are discarded anyway) — never mutate after abandonment
+                return None
+            return plane.harvest(flat)
+
+        if cfg.dispatch_deadline <= 0:           # unbounded: fetch inline
+            losses_all.extend(fetch().tolist())
+            return
+        budget = Deadline(cfg.dispatch_deadline)
+        box: list = []
+
+        def run():
+            try:
+                box.append(("ok", fetch()))
+            except BaseException as e:           # re-raised on the loop
+                box.append(("err", e))
+
+        t = threading.Thread(target=run, name="anakin-harvest",  # graftlint: disable=thread-discipline -- bounded-join fetch; abandoned on a hard wedge BY DESIGN, a Supervisor restart would re-block on the dead device
+                             daemon=True)
+        t.start()
+        t.join(budget.remaining())
+        if t.is_alive():
+            # over budget — grant one extra budget of grace so a
+            # slow-but-COMPLETING fetch lands in the slow grade below
+            # (drain + full snapshot) instead of being abandoned
+            t.join(cfg.dispatch_deadline)
+        if t.is_alive():
+            # HARD wedge: the device wait never returned.  It cannot be
+            # interrupted, only abandoned — this dispatch's losses are
+            # lost, and the drain/snapshot paths must not touch the
+            # device unbounded (see the caller)
+            log.error(
+                "anakin dispatch harvest exceeded its %.1fs budget and "
+                "has not returned after as much grace — treating the "
+                "device as hard-wedged: abandoning the fetch, "
+                "best-effort snapshot, aborting cleanly (resume with "
+                "--resume)", cfg.dispatch_deadline)
+            abandoned.set()
+            wedged = hard_wedged = True
+            return
+        tag, val = box[0]
+        if tag == "err":
+            raise val
+        losses_all.extend(val.tolist())
+        if budget.expired:
+            log.error(
+                "anakin dispatch harvest took %.1fs (budget %.1fs) — "
+                "treating the device as wedged: draining, snapshotting "
+                "and aborting cleanly (resume with --resume)",
+                budget.elapsed(), cfg.dispatch_deadline)
+            wedged = True
+
+    while updates < target and not wedged:
         if stop is not None and stop():
             break
         if not plane.ready:
@@ -835,7 +930,7 @@ def run_anakin_loop(learner: Any, plane: AnakinPlane,
         with tracer.span("learner.step_dispatch"):
             learner.state, flat = plane.dispatch(learner.state)
         pending.append(flat)
-        while len(pending) > cfg.superstep_pipeline:
+        while len(pending) > cfg.superstep_pipeline and not wedged:
             with tracer.span("learner.result_sync"):
                 harvest_one()
 
@@ -851,16 +946,74 @@ def run_anakin_loop(learner: Any, plane: AnakinPlane,
             learner._save(updates, t0)
         if (snapshot_fn is not None and cfg.replay_snapshot_interval > 0
                 and time.time() - last_snap > cfg.replay_snapshot_interval):
-            while pending:  # snapshots need no dispatch in flight
-                harvest_one()
-            snapshot_fn(updates)
-            last_snap = time.time()
-    while pending:
+            while pending and not hard_wedged:
+                harvest_one()   # snapshots need no dispatch in flight
+            if not hard_wedged:
+                snapshot_fn(updates)
+                last_snap = time.time()
+    while pending and not hard_wedged:
         harvest_one()
+    if wedged and snapshot_fn is not None:
+        # the resumable artifact of the clean abort: full loop state,
+        # parked where --resume restores it bit-exact.  On a HARD wedge
+        # the snapshot itself reads device handles and can block on the
+        # same dead device — bound the attempt instead of trading a hang
+        # for a hang (if it times out, the last periodic snapshot stays
+        # the resume point)
+        if not hard_wedged:
+            snapshot_fn(updates)
+        else:
+            snapped = threading.Event()
+
+            def snap():
+                try:
+                    snapshot_fn(updates)
+                    snapped.set()
+                except Exception:
+                    log.exception("hard-wedge snapshot attempt failed")
+
+            st = threading.Thread(target=snap, name="anakin-wedge-snap",  # graftlint: disable=thread-discipline -- one best-effort bounded-join snapshot at abort; nothing to supervise after it
+                                  daemon=True)
+            st.start()
+            st.join(max(10.0, 10.0 * cfg.dispatch_deadline))
+            if not snapped.is_set():
+                log.error("hard-wedge snapshot did not complete in time "
+                          "— aborting without a fresh snapshot")
 
     learner.env_steps = plane.env_steps
-    metrics = learner._finish_device_run(losses_all[-100:], t0)
+    if hard_wedged:
+        # the shared epilogue's final checkpoint save device_gets params
+        # from the SAME wedged device — bound it like the snapshot above
+        # so a dead device cannot turn the clean abort back into a hang
+        # (on a timeout the last complete step checkpoint stays the
+        # params half of the resume pair)
+        fin_box: dict = {}
+
+        def fin():
+            try:
+                fin_box["metrics"] = learner._finish_device_run(
+                    losses_all[-100:], t0)
+            except Exception:
+                log.exception("hard-wedge epilogue save failed")
+
+        ft = threading.Thread(target=fin, name="anakin-wedge-fin",  # graftlint: disable=thread-discipline -- one bounded-join epilogue save at abort; nothing to supervise after it
+                              daemon=True)
+        ft.start()
+        ft.join(max(10.0, 10.0 * cfg.dispatch_deadline))
+        metrics = fin_box.get("metrics")
+        if metrics is None:
+            log.error("hard-wedge final save did not complete in time — "
+                      "summarizing without it")
+            metrics = dict(
+                num_updates=learner.num_updates,
+                env_steps=learner.env_steps,
+                minutes=learner.start_minutes + (time.time() - t0) / 60.0,
+                mean_loss=(float(np.mean(losses_all[-100:]))
+                           if losses_all else float("nan")))
+    else:
+        metrics = learner._finish_device_run(losses_all[-100:], t0)
     metrics["losses"] = losses_all
+    metrics["dispatch_wedged"] = wedged
     metrics["env_steps"] = plane.env_steps
     metrics["anakin_frames"] = plane.frames
     metrics["anakin_super_steps"] = plane.super_steps

@@ -285,7 +285,10 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
 
     ``act_info`` non-None selects serve mode: acting becomes an RPC
     through a :class:`~r2d2_tpu.parallel.inference_service.
-    RemoteActClient` — no network, no weight wait, no drain thread.
+    RemoteActClient` — no network and no blocking weight wait; the pump
+    still feeds the fleet's local ParamStore (non-blocking drain) as the
+    degraded-mode fallback weights the client acts on when its circuit
+    opens (utils/resilience.py).
 
     ``stats_info`` attaches the telemetry stats slab
     (telemetry/slab.py): after every run burst the fleet publishes its
@@ -303,15 +306,47 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
     from r2d2_tpu.utils.store import ParamStore
 
     store = ParamStore()
+    # the TRAINER's version number of the last decoded pump (the local
+    # store's own publish counter drifts when the pump skips versions) —
+    # published in the stats so the staleness watchdog compares like with
+    # like; a dict cell because the drain thread updates it
+    pumped = {"version": 0}
+
+    def weight_drain():
+        while not stop_event.is_set():
+            try:
+                payload = weights_q.get(timeout=0.2)
+            except Empty:
+                continue
+            version, params = _decode_pump(payload)
+            store.publish(params)
+            pumped["version"] = version
+
     client = None
     if act_info is not None:
         # serve mode: the trainer's InferenceService owns params and
-        # recurrent state; this process only steps envs and cuts blocks
+        # recurrent state; this process only steps envs and cuts blocks.
+        # The weight pump still feeds this fleet (non-blocking: remote
+        # acting needs no weights) — it is the degraded-mode param feed
+        # the client's local fallback acts on when its circuit opens.
         from r2d2_tpu.parallel.inference_service import RemoteActClient
 
+        def local_act_factory():
+            # built lazily, only if the circuit ever opens: the exact
+            # local-inference twin (same cfg, CPU-pinned process), so
+            # degraded-mode blocks are bit-identical to local mode's
+            return make_act_fn(cfg, create_network(cfg, action_dim))
+
         client = RemoteActClient(cfg, action_dim, spec.hi - spec.lo,
-                                 act_info, stop_event, src=spec.fleet_id)
+                                 act_info, stop_event, src=spec.fleet_id,
+                                 param_store=store,
+                                 local_act_factory=local_act_factory)
         act_fn = client
+        if weights_q is not None:
+            # fire-and-forget safe (see the local-mode drain below): a
+            # dead drain costs staleness of the FALLBACK weights only
+            threading.Thread(target=weight_drain, daemon=True,  # graftlint: disable=thread-discipline -- stale fallback weights, not wedges, are the worst a dead drain causes
+                             name=f"fleet{spec.fleet_id}-weights").start()
     else:
         deadline = time.time() + 120.0
         first = None
@@ -325,15 +360,9 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                 continue
         if first is None:  # stopped before the first publication
             return
-        store.publish(_decode_pump(first)[1])
-
-        def weight_drain():
-            while not stop_event.is_set():
-                try:
-                    payload = weights_q.get(timeout=0.2)
-                except Empty:
-                    continue
-                store.publish(_decode_pump(payload)[1])
+        version0, params0 = _decode_pump(first)
+        store.publish(params0)
+        pumped["version"] = version0
 
         # fire-and-forget safe: the drain only republishes pumped weight
         # snapshots into this subprocess's local ParamStore — if it dies,
@@ -357,14 +386,19 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
         if stats_writer is None:
             return
         # lockstep fleet: one actor iteration steps every lane
-        stats_writer.publish(dict(
+        stats = dict(
             env_steps=actor.actor_steps * num_lanes,
             blocks_produced=producer.blocks_sent,
             episodes=producer.episodes,
             episode_reward_sum=producer.episode_reward_sum,
-            param_version=store.get()[0],
+            param_version=pumped["version"],
             incarnation=spec.incarnation,
-        ))
+        )
+        if client is not None:
+            # act-RPC failover state (retries, circuit opens/state,
+            # degraded-mode acts) — merged trainer-side as resilience.*
+            stats.update(client.stats)
+        stats_writer.publish(stats)
     # incarnation shifts both the env seeds and the exploration stream so
     # a respawned fleet explores fresh trajectories instead of replaying
     # the ones its dead predecessor already contributed
@@ -443,9 +477,10 @@ class ProcessFleetPlane:
       (throttled — at most ~5 snapshots/s regardless of the learner's
       publish cadence; one pickle per version shared across the F queue
       puts, narrowed to bf16 on the wire under ``param_pump_dtype``).
-      Serve mode replaces it with ``inference_serve`` — the centralized
-      act server's loop (InferenceService.serve_once) — since weights
-      then never leave the trainer.
+      Serve mode adds ``inference_serve`` — the centralized act server's
+      loop (InferenceService.serve_once) — and keeps the pump as the
+      fleets' degraded-mode param feed (their local-fallback act path
+      when a circuit opens; utils/resilience.py).
     - ``fleet_watch``: respawns dead fleet processes on their lane shard,
       up to ``max_restarts`` per fleet; an exhausted budget raises, which
       the Supervisor escalates to a fabric stop instead of a silent
@@ -515,6 +550,20 @@ class ProcessFleetPlane:
         self.failed = False
         self.param_store = None
         self._pumped_version = 0
+        # chaos fault sites for the plane's fabric loops (freeze_service /
+        # stall_pump); train() installs the run's injector here and on the
+        # service (drop/garble response sites)
+        self.chaos = None
+        # param-staleness watchdog: per fleet, when it was FIRST observed
+        # running behind the store's newest version.  The timestamp is
+        # pinned until the fleet's own version advances (pump alive) or
+        # catches up, so staleness keeps growing while the learner keeps
+        # publishing — measuring from the store's last version edge
+        # instead would reset on every publish and a dead pump could
+        # never cross the budget
+        self.stale_params_budget = 30.0   # seconds before health degrades
+        self._behind_since: List[Optional[float]] = [None] * F
+        self._fleet_version_seen = [0.0] * F
         self._rr = 0              # ingest round-robin cursor
         self.blocks_ingested = 0
         self.frames_ingested = 0
@@ -597,10 +646,10 @@ class ProcessFleetPlane:
     def pump_params_once(self) -> bool:
         """Forward the current ParamStore version to every fleet if it is
         newer than the last pumped one.  Returns True if it pumped.
-        Serve mode pumps nothing — the service reads the ParamStore
-        directly."""
-        if self.service is not None:
-            return False
+        Serve-mode acting never consumes these (the service reads the
+        ParamStore directly), but the pump still runs: it is the
+        degraded-mode param feed each fleet's local-fallback act path
+        uses when its circuit opens (utils/resilience.py)."""
         version, _ = self.param_store.get()
         if version == self._pumped_version:
             return False
@@ -629,9 +678,10 @@ class ProcessFleetPlane:
         device→host transfers + F serialisations); None re-snapshots —
         the watchdog respawn path, where the predecessor consumed the
         queued snapshot and the version may not have changed.  Serve mode
-        skips weights entirely and provisions the fleet's act channel
-        instead, zeroing (respawn) or restoring (--resume) its shard of
-        the server-resident hidden state."""
+        additionally provisions the fleet's act channel, zeroing
+        (respawn) or restoring (--resume) its shard of the
+        server-resident hidden state; its weight queue is the
+        degraded-mode param feed."""
         old = self.channels[f]
         if old is not None:
             try:
@@ -646,18 +696,19 @@ class ProcessFleetPlane:
         self.ctrl_queues[f] = self.ctx.Queue()
         self.snap_queues[f] = self.ctx.Queue()
         act_info = None
+        # every fleet gets a weight queue — local mode acts on it; serve
+        # mode keeps it as the degraded-mode param feed (the fallback
+        # path's weights when the fleet's act circuit opens)
+        self.weight_queues[f] = self.ctx.Queue(maxsize=2)
+        # prime BEFORE start so the child finds its initial weights
+        if payload is None:
+            host, version = self._snapshot_params()
+            if host is not None:
+                payload = self._encode_pump(version, host)
+        if payload is not None:
+            self._prime(f, payload)
         if self.service is not None:
-            self.weight_queues[f] = None
             act_info = self.service.make_channel(f).producer_info()
-        else:
-            self.weight_queues[f] = self.ctx.Queue(maxsize=2)
-            # prime BEFORE start so the child finds its initial weights
-            if payload is None:
-                host, version = self._snapshot_params()
-                if host is not None:
-                    payload = self._encode_pump(version, host)
-            if payload is not None:
-                self._prime(f, payload)
         spec = dataclasses.replace(self.specs[f],
                                    incarnation=self.restarts[f])
         restore_snap, self._restore_snaps[f] = self._restore_snaps[f], None
@@ -704,16 +755,15 @@ class ProcessFleetPlane:
         """Spawn every fleet.  ``param_store`` must already hold the
         initial publication (Learner.__init__ publishes v1)."""
         self.param_store = param_store
-        payload = None
         if self.service is not None:
             self.service.start(param_store)
-        else:
-            # ONE device→host transfer AND one pickle shared by every
-            # fleet's priming
-            host, version = self._snapshot_params()
-            self._pumped_version = version
-            if host is not None:
-                payload = self._encode_pump(version, host)
+        # ONE device→host transfer AND one pickle shared by every fleet's
+        # priming (serve mode too: the degraded-mode param feed)
+        payload = None
+        host, version = self._snapshot_params()
+        self._pumped_version = version
+        if host is not None:
+            payload = self._encode_pump(version, host)
         for f in range(self.num_fleets):
             self._spawn(f, payload=payload)
 
@@ -752,6 +802,80 @@ class ProcessFleetPlane:
             return dict(totals=self.stats_merger.totals(),
                         per_fleet=self.stats_merger.per_slot(),
                         incarnations=self.stats_merger.incarnations())
+
+    # --------------------------------------------------------- resilience
+    def _store_version(self) -> int:
+        """Newest published ParamStore version (0 when no store is
+        attached) — the reference fleet staleness is measured against."""
+        if self.param_store is None:
+            return 0
+        version, _ = self.param_store.get()
+        return version
+
+    def resilience_health(self, stats: Optional[dict] = None) -> dict:
+        """The plane's degraded-mode verdict: per-fleet param staleness
+        (seconds a fleet has been acting/training on an older version
+        than the newest published one — a dead pump shows up here
+        instead of as silent training on frozen weights), the serve
+        fleets' circuit-breaker states, and the merged ``resilience.*``
+        counters.  ``degraded`` is True when any circuit is not closed
+        or any fleet is stale past ``stale_params_budget``."""
+        from r2d2_tpu.utils.resilience import CLOSED
+
+        stats = stats if stats is not None else self.poll_fleet_stats()
+        now = time.time()
+        stale, circuits = [], []
+        # the per-fleet staleness clocks are read-modify-write state
+        # shared by every health caller (exporter /healthz, log loop) —
+        # unserialized, a caller holding an OLDER stats snapshot could
+        # roll _fleet_version_seen backwards past a version edge and
+        # spuriously restart a dead-pump clock
+        with self._stats_lock:
+            version = self._store_version()
+            for f, row in enumerate(stats["per_fleet"]):
+                # clamp monotone: a caller that polled its stats snapshot
+                # BEFORE another caller's newer one must not roll the
+                # fleet's seen version back and fake a pump delivery
+                fv = max(row.get("param_version", 0.0),
+                         self._fleet_version_seen[f])
+                if version == 0 or fv >= version:
+                    self._behind_since[f] = None
+                elif fv <= 0:
+                    # the fleet has not reported a received version yet
+                    # (spawn / first-compile warm-up before its first
+                    # stats publication) — staleness is unmeasurable,
+                    # and arming the clock here would flip /healthz to
+                    # "degraded" on every cold start slower than the
+                    # budget
+                    self._behind_since[f] = None
+                elif (self._behind_since[f] is None
+                      or fv > self._fleet_version_seen[f]):
+                    # first seen behind, or the pump delivered something
+                    # since the last scrape — restart the clock
+                    self._behind_since[f] = now
+                self._fleet_version_seen[f] = fv
+                since = self._behind_since[f]
+                stale.append(0.0 if since is None
+                             else max(0.0, now - since))
+                circuits.append(int(row.get("circuit_state", 0.0)))
+        totals = stats["totals"]
+        max_stale = max(stale, default=0.0)
+        circuits_open = sum(1 for c in circuits if c != CLOSED)
+        out = dict(
+            circuit_states=circuits,
+            circuits_open=circuits_open,
+            retries=totals.get("act_retries", 0.0),
+            circuit_opens=totals.get("circuit_opens", 0.0),
+            local_acts=totals.get("local_acts", 0.0),
+            stale_params_s=[round(s, 3) for s in stale],
+            max_stale_params_s=round(max_stale, 3),
+            degraded=bool(circuits_open
+                          or max_stale > self.stale_params_budget),
+        )
+        for f, s in enumerate(stale):
+            self.registry.set_gauge("fleet.stale_params_s", s,
+                                    fleet=str(f))
+        return out
 
     # ------------------------------------------------------------- ingest
     def ingest_once(self, sink: BlockSink, timeout: float = 0.1
@@ -815,8 +939,11 @@ class ProcessFleetPlane:
 
     def make_loops(self, stop: Callable[[], bool], sink: BlockSink):
         """The plane's supervised fabric loops for ``train()``: block
-        ingest, process watchdog, and either the weight pump (local
-        inference) or the batched act server (serve mode)."""
+        ingest, process watchdog, the weight pump (local acting — or,
+        under serve mode, the degraded-mode param feed), and the batched
+        act server (serve mode).  The ``freeze_service`` / ``stall_pump``
+        chaos sites live in the respective loop bodies (armed when
+        train() installs ``self.chaos``)."""
 
         def fleet_ingest():
             while not stop():
@@ -824,12 +951,30 @@ class ProcessFleetPlane:
 
         def param_pump():
             while not stop():
+                chaos = self.chaos
+                if chaos is not None:
+                    stall = chaos.pump_stall_seconds()
+                    if stall > 0:
+                        log.warning("chaos: stalling the param pump for "
+                                    "%.1fs", stall)
+                        time.sleep(stall)
                 self.pump_params_once()
                 time.sleep(0.2)
 
         def inference_serve():
             while not stop():
-                self.service.serve_once()
+                served = self.service.serve_once()
+                chaos = self.chaos
+                # one chaos opportunity per SERVED batch (not per idle
+                # poll): the freeze drill is only meaningful under real
+                # traffic — fleets must be attached and acting when the
+                # service goes dark, or the drill proves nothing
+                if chaos is not None and served > 0:
+                    freeze = chaos.service_freeze_seconds()
+                    if freeze > 0:
+                        log.warning("chaos: freezing the inference "
+                                    "service for %.1fs", freeze)
+                        time.sleep(freeze)
 
         def fleet_watch():
             while not stop():
@@ -839,12 +984,12 @@ class ProcessFleetPlane:
         loops = [("fleet_ingest", fleet_ingest)]
         if self.service is not None:
             loops.append(("inference_serve", inference_serve))
-        else:
-            loops.append(("param_pump", param_pump))
+        loops.append(("param_pump", param_pump))
         loops.append(("fleet_watch", fleet_watch))
         return loops
 
     def health(self) -> dict:
+        stats = self.poll_fleet_stats()
         out = dict(
             fleets=self.num_fleets,
             alive=sum(1 for p in self.procs
@@ -855,7 +1000,8 @@ class ProcessFleetPlane:
             frames_ingested=self.frames_ingested,
             blocks_corrupt=self.blocks_corrupt,
             blocks_per_fleet=list(self.blocks_per_fleet),
-            stats=self.poll_fleet_stats(),
+            stats=stats,
+            resilience=self.resilience_health(stats),
         )
         if self.service is not None:
             out["service"] = self.service.health()

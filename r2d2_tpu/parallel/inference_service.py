@@ -19,8 +19,9 @@ process boundary.  ``cfg.actor_inference="serve"`` wires it:
   the response queue; the reply carries ``(q, new_hidden)`` views into the
   same slab.  A CRC32 integrity word — written last, covering the payload
   plus the token header, the block channel's own convention — lets the
-  server detect a garbled request (counted + logged; still served, since
-  dropping it would wedge the lockstep fleet forever).
+  server detect a garbled request (counted + logged + DROPPED; the
+  fleet's bounded retry resends it clean, so the lockstep fleet no
+  longer wedges on a lost reply).
 - **Server-resident recurrent state**: ONE ``(num_actors, 2, layers, H)``
   hidden array lives in the :class:`InferenceService`, indexed by global
   lane id via the fleet shards, zeroed by each request's reset mask, and
@@ -32,18 +33,52 @@ process boundary.  ``cfg.actor_inference="serve"`` wires it:
   full-state snapshot restores the server array bit-exact from the
   per-fleet actor snapshots (``ProcessFleetPlane._spawn``).
 - **Zero-staleness weights**: the service reads params straight from the
-  trainer's ParamStore each batch — serve-mode fleets need no weight
-  queues, no per-fleet pickled snapshots, no refresh cadence at all.
+  trainer's ParamStore each batch — the serving path has no pump lag.
+  (The per-fleet weight pump still runs under serve mode, purely as the
+  degraded-mode param feed: the fallback weights a fleet's local act
+  twin uses when its circuit opens.)
 - **Peek requests**: the episode-step-cap bootstrap needs Q at the
   post-step state *without* advancing recurrent state (the VectorActor
-  calls act twice that iteration).  A request with ``commit=0`` computes
-  q but neither applies reset masks nor scatters hidden.
+  calls act twice that iteration).  A ``mode=MODE_PEEK`` request
+  computes q but neither applies reset masks nor scatters hidden.
 
 Intentional divergence from a strict Seed-RL split: the ε-greedy draw
 stays fleet-side (the response carries the full q row, tiny at Atari
 action counts) so the exploration RNG remains part of the resumable actor
 snapshot — the recovery machinery's bit-exact resume guarantees survive
 serve mode unchanged.
+
+**Degraded-mode failover** (utils/resilience.py): the act RPC is no
+longer allowed to kill a fleet.  Every attempt is bounded by
+``cfg.act_response_timeout`` and verified by a response CRC; a timeout or
+a garbled response retries bounded (jittered backoff, each retry sent as
+a *resync* request — see below — so a half-served predecessor can never
+double-advance server state), and exhausting the retries opens the
+fleet's :class:`~r2d2_tpu.utils.resilience.CircuitBreaker`.  While the
+circuit is open the fleet **degrades to fleet-local inference**: a
+lazily-built local act twin (the same executable local mode runs) acting
+on the fleet's last pumped weight snapshot — serve fleets now receive the
+param pump for exactly this — against the fleet's own authoritative
+hidden carry.  Every cooldown the breaker admits one half-open *probe*:
+a commit request in **resync mode**, which ships the fleet's current
+hidden carry in the slab's ``sync_hidden`` region; the server loads it
+over the shard's server-resident rows before acting, so the re-attached
+path continues bit-exact from wherever local inference left the carry.
+A probe success closes the circuit (re-attach), a failure re-opens it.
+The fleet-side counters (retries, circuit opens, local acts, state)
+publish through the telemetry stats slab as ``resilience.*``.
+
+Request modes on the token queue — ``(seq, mode)``: ``0`` peek (no state
+advance), ``1`` commit, ``2`` resync+commit (load ``sync_hidden`` first).
+A ``req_seq`` slab word lets the server drop tokens superseded by a
+retry (the fleet only waits on its newest seq), and the response CRC —
+written last, over the q row plus (for commits) the response hidden —
+closes the torn/garbled-reply window the request CRC never covered.
+A request failing its own CRC is *dropped*, not served (counted in
+``service.requests_corrupt``): acting on a garbled slab — worst, loading
+a torn ``sync_hidden`` over the shard — would stamp a valid response CRC
+over a poisoned reply the fleet cannot detect; the bounded retry resends
+it clean instead.
 
 The service loop runs as a supervised fabric thread
 (``ProcessFleetPlane.make_loops`` → ``inference_serve``); ``serve_once``
@@ -69,6 +104,13 @@ import numpy as np
 from r2d2_tpu.config import Config
 from r2d2_tpu.parallel.actor_procs import FleetStopped
 from r2d2_tpu.replay.block import payload_crc32, slot_layout, slot_views
+from r2d2_tpu.utils.resilience import (
+    CLOSED,
+    OPEN,
+    CircuitBreaker,
+    Deadline,
+    RetryPolicy,
+)
 from r2d2_tpu.utils.trace import HOST_TRANSFERS
 
 log = logging.getLogger(__name__)
@@ -76,33 +118,70 @@ log = logging.getLogger(__name__)
 # request payload fields, in CRC order (shared by producer + verifier)
 _REQ_FIELDS = ("obs", "last_action", "last_reward", "reset_mask")
 
+# act-request modes on the token queue (``(seq, mode)``)
+MODE_PEEK = 0     # q only; no reset application, no hidden scatter
+MODE_COMMIT = 1   # normal act: advance server-resident hidden
+MODE_RESYNC = 2   # commit, but FIRST load the shard's hidden from the
+                  # slab's sync_hidden region (retries + re-attach probes:
+                  # the fleet's carry is authoritative, so a half-served
+                  # predecessor attempt can never double-advance state)
+
+
+class ActTimeout(Exception):
+    """One act RPC attempt exceeded ``cfg.act_response_timeout``."""
+
+
+class ActGarbled(Exception):
+    """A response arrived but failed its CRC32 integrity check."""
+
 
 def act_slot_spec(cfg: Config, action_dim: int, num_lanes: int):
     """(name, shape, dtype) of ONE fleet's act request/response slot.
 
     Request region (fleet-written): the batched AgentState the act fn
-    consumes, minus hidden (server-resident), plus the reset mask and the
-    CRC32 integrity word.  Response region (server-written): the q row
-    per lane and the post-step hidden rows for block recording."""
+    consumes, minus hidden (server-resident), plus the reset mask, the
+    resync hidden rows (only meaningful for MODE_RESYNC requests), the
+    ``req_seq`` word (lets the server drop tokens superseded by a retry)
+    and the CRC32 integrity word.  Response region (server-written): the
+    q row per lane, the post-step hidden rows for block recording, and
+    the response CRC32 (written last)."""
     n = num_lanes
     return (
         ("obs", (n, *cfg.stored_obs_shape), np.uint8),
         ("last_action", (n, action_dim), np.float32),
         ("last_reward", (n,), np.float32),
         ("reset_mask", (n,), np.uint8),
+        ("sync_hidden", (n, 2, cfg.lstm_layers, cfg.hidden_dim),
+         np.float32),
+        ("req_seq", (1,), np.int64),
         ("req_crc", (1,), np.uint32),
         ("q", (n, action_dim), np.float32),
         ("rsp_hidden", (n, 2, cfg.lstm_layers, cfg.hidden_dim), np.float32),
+        ("rsp_crc", (1,), np.uint32),
     )
 
 
-def act_request_crc(views: dict, seq: int, commit: bool) -> int:
+def act_request_crc(views: dict, seq: int, mode: int) -> int:
     """CRC32 over the request payload plus the queue token header, so a
     slab/token mismatch is caught along with a torn or garbled write.
+    Resync requests additionally cover the sync_hidden rows they carry.
     The convention (header words, payload order, mask) is replay.block's
     — one definition across every shm channel."""
-    return payload_crc32((seq, int(commit)),
-                         [views[name] for name in _REQ_FIELDS])
+    fields = [views[name] for name in _REQ_FIELDS]
+    if int(mode) == MODE_RESYNC:
+        fields.append(views["sync_hidden"])
+    return payload_crc32((seq, int(mode)), fields)
+
+
+def act_response_crc(views: dict, seq: int, mode: int) -> int:
+    """CRC32 over the response region (q row; plus the hidden rows for
+    commit-mode replies, which are the only ones that carry them).
+    Written LAST by the server; the fleet verifies before consuming, and
+    a mismatch is a bounded-retry failure, not a wedge."""
+    fields = [views["q"]]
+    if int(mode) != MODE_PEEK:
+        fields.append(views["rsp_hidden"])
+    return payload_crc32((seq, int(mode)), fields)
 
 
 def _span(tracer, name: str):
@@ -151,18 +230,29 @@ class RemoteActClient:
 
     Conforms to the ``make_act_fn`` signature ``(params, obs, last_action,
     last_reward, hidden) → (q, new_hidden)`` so it plugs straight into a
-    VectorActor — ``params`` and ``hidden`` are ignored (both live in the
-    trainer's InferenceService).  The returned arrays are views into the
-    slab, valid until the next call (the actor's per-iteration reads all
-    complete before then).  Waiting polls ``stop_event`` so shutdown never
-    hangs a fleet mid-step (raises FleetStopped, like the block
-    producer)."""
+    VectorActor — ``params`` is ignored (the server reads the ParamStore;
+    the local fallback path reads the fleet's own pumped store) and
+    ``hidden`` is the fleet's authoritative carry, normally mirrored back
+    from the server's replies and consumed directly by the degraded-mode
+    local act path.  The returned arrays are views into the slab (remote)
+    or fresh host arrays (local fallback), valid until the next call.
+    Waiting polls ``stop_event`` so shutdown never hangs a fleet mid-step
+    (raises FleetStopped, like the block producer).
 
-    RESPONSE_TIMEOUT = 600.0   # orphan bound: trainer SIGKILLed mid-rpc
+    Failure handling (module docstring): every attempt is bounded by
+    ``cfg.act_response_timeout`` and CRC-verified; retries are resync
+    requests; exhausted retries open the circuit breaker and the client
+    degrades to the lazily-built local act twin until a half-open probe
+    re-attaches.  ``stats`` holds the slab-published ``resilience.*``
+    counters."""
 
     def __init__(self, cfg: Config, action_dim: int, num_lanes: int,
-                 info: Tuple[str, Any, Any], stop_event, src: int = 0):
+                 info: Tuple[str, Any, Any], stop_event, src: int = 0,
+                 param_store=None, local_act_factory=None,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         name, self.req_q, self.rsp_q = info
+        self.cfg = cfg
         self.shm = shared_memory.SharedMemory(name=name)
         self.spec = act_slot_spec(cfg, action_dim, num_lanes)
         nbytes, offsets = slot_layout(self.spec)
@@ -171,15 +261,51 @@ class RemoteActClient:
         self.stop_event = stop_event
         self.src = src
         self._seq = 0
+        self.timeout = float(cfg.act_response_timeout)
+        # the degraded-mode kit: a param feed (the fleet's pumped store)
+        # plus a factory for the local act twin, built only if ever needed
+        self.param_store = param_store
+        self._local_act_factory = local_act_factory
+        self._local_act = None
+        self._local_params = None
+        self._local_version = -1
+        self.retry = retry if retry is not None else RetryPolicy(
+            attempts=3, base=0.05, max_delay=1.0,
+            seed=cfg.seed + 7_577 * (src + 1))
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            name=f"fleet{src}.act",
+            cooldown=max(0.5, min(5.0, self.timeout)),
+            on_transition=self._on_transition)
+        # slab-published resilience counters (FLEET_STAT_FIELDS names)
+        self.stats = dict(act_retries=0, circuit_opens=0, local_acts=0,
+                          circuit_state=float(CLOSED))
         # lanes whose server-side hidden must be zeroed at the next commit
         # request; starts all-pending (a fresh incarnation's lanes all
         # begin a new episode, and a respawn must never inherit state)
         self._pending_resets = set(range(num_lanes))
 
+    # ------------------------------------------------------------- breaker
+    def _on_transition(self, bname: str, old: int, new: int) -> None:
+        self.stats["circuit_state"] = float(new)
+        if new == OPEN:
+            self.stats["circuit_opens"] += 1
+            log.warning(
+                "fleet%d: act circuit OPEN (service unresponsive) — "
+                "degrading to fleet-local inference on the last pumped "
+                "weights; half-open probe every %.1fs", self.src,
+                self.breaker.cooldown)
+        elif new == CLOSED:
+            log.warning("fleet%d: act circuit CLOSED — re-attached to the "
+                        "inference service (hidden resynced from the "
+                        "fleet's carry)", self.src)
+
     # --------------------------------------------------- VectorActor hooks
     def note_reset(self, lane: int) -> None:
         """VectorActor._reset_lane: lane ``lane`` starts a fresh episode —
-        its server-resident hidden is zeroed at the next commit request."""
+        its server-resident hidden is zeroed at the next commit request.
+        (Local-fallback commits clear these too: the reset is already
+        reflected in the fleet's carry, which is what a later re-attach
+        probe resyncs to the server.)"""
         self._pending_resets.add(int(lane))
 
     def clear_reset_notes(self) -> None:
@@ -189,47 +315,170 @@ class RemoteActClient:
         self._pending_resets.clear()
 
     def __call__(self, params, obs, last_action, last_reward, hidden):
-        return self._rpc(obs, last_action, last_reward, commit=True)
+        return self._rpc(obs, last_action, last_reward, hidden,
+                         MODE_COMMIT)
 
     def peek(self, params, obs, last_action, last_reward, hidden):
         """Bootstrap forward (episode-step cap): q at the given inputs
         WITHOUT advancing server state — no reset application, no hidden
         scatter.  Returns ``(q, None)``."""
-        return self._rpc(obs, last_action, last_reward, commit=False)
+        return self._rpc(obs, last_action, last_reward, hidden, MODE_PEEK)
 
-    # -------------------------------------------------------------- rpc
-    def _rpc(self, obs, last_action, last_reward, commit: bool):
+    # ---------------------------------------------------------- local path
+    def _await_params(self):
+        """Latest pumped params for the local act twin, committed to a
+        local device once per version.  Blocks (stop-aware) until the
+        param feed delivers the first snapshot — the pump primes each
+        fleet's queue at spawn, so in practice this returns immediately."""
+        if self.param_store is None:
+            raise RuntimeError(
+                f"fleet{self.src}: circuit open but no local fallback "
+                "was provisioned (no param feed)")
+        while True:
+            version, params = self.param_store.get()
+            if params is not None:
+                if version != self._local_version:
+                    import jax
+
+                    self._local_params = jax.device_put(
+                        params, jax.local_devices()[0])
+                    self._local_version = version
+                return self._local_params
+            if self.stop_event.is_set():
+                raise FleetStopped
+            time.sleep(0.05)
+
+    def _local(self, obs, last_action, last_reward, hidden, mode: int):
+        """Degraded-mode act: the fleet's own jitted twin over its last
+        pumped weights and its authoritative hidden carry — the exact
+        executable local-inference mode runs, so blocks stay bit-exact
+        with what a local-mode fleet would produce from those weights."""
+        if self._local_act is None:
+            if self._local_act_factory is None:
+                raise RuntimeError(
+                    f"fleet{self.src}: circuit open but no local act "
+                    "factory was provisioned")
+            log.warning("fleet%d: building the local act twin for "
+                        "degraded-mode inference", self.src)
+            self._local_act = self._local_act_factory()
+        params = self._await_params()
+        q, new_hidden = self._local_act(params, obs, last_action,
+                                        last_reward, hidden)
+        self.stats["local_acts"] += 1
+        if mode == MODE_PEEK:
+            return np.asarray(q), None
+        # the reset is already reflected in the fleet's carry — the next
+        # resync probe transfers it wholesale, so the server-side mask
+        # notes are spent exactly like after a remote commit
+        self._pending_resets.clear()
+        return np.asarray(q), np.asarray(new_hidden)
+
+    # ---------------------------------------------------------- remote rpc
+    def _write_request(self, obs, last_action, last_reward, hidden,
+                       mode: int) -> None:
         v = self.views
         v["obs"][:] = obs
         v["last_action"][:] = last_action
         v["last_reward"][:] = last_reward
         mask = np.zeros(self.num_lanes, np.uint8)
-        if commit and self._pending_resets:
+        if mode != MODE_PEEK and self._pending_resets:
             mask[sorted(self._pending_resets)] = 1
         v["reset_mask"][:] = mask
+        if mode == MODE_RESYNC:
+            v["sync_hidden"][:] = hidden
         self._seq += 1
+        v["req_seq"][0] = self._seq
         # CRC last: the slab is only valid once the integrity word matches
-        v["req_crc"][0] = act_request_crc(v, self._seq, commit)
-        self.req_q.put((self._seq, int(commit)))
-        deadline = time.time() + self.RESPONSE_TIMEOUT
+        v["req_crc"][0] = act_request_crc(v, self._seq, mode)
+        self.req_q.put((self._seq, int(mode)))
+
+    def _await_response(self, mode: int,
+                        timeout: Optional[float] = None) -> None:
+        """Wait (bounded, stop-aware) for the reply to ``self._seq`` and
+        verify its CRC.  Raises ActTimeout / ActGarbled — both retryable
+        failures, never fleet-killing errors."""
+        budget = self.timeout if timeout is None else timeout
+        deadline = Deadline(budget)
         while True:
             if self.stop_event.is_set():
                 raise FleetStopped
             try:
-                seq = self.rsp_q.get(timeout=0.2)
+                seq = self.rsp_q.get(timeout=deadline.poll_timeout(0.2))
             except Empty:
-                if time.time() > deadline:
-                    raise RuntimeError(
+                if deadline.expired:
+                    raise ActTimeout(
                         f"fleet{self.src}: no inference response within "
-                        f"{self.RESPONSE_TIMEOUT:.0f} s — trainer gone?")
+                        f"{budget:.1f} s (seq {self._seq})")
                 continue
-            if seq == self._seq:
-                break
-            # stale token from a retired incarnation's race: ignore
-        if commit:
-            self._pending_resets.clear()
-            return v["q"], v["rsp_hidden"]
-        return v["q"], None
+            if seq != self._seq:
+                continue   # stale token from a superseded attempt: ignore
+            v = self.views
+            if int(v["rsp_crc"][0]) != act_response_crc(v, seq, mode):
+                raise ActGarbled(
+                    f"fleet{self.src}: response {seq} failed CRC32")
+            return
+
+    def _attempt(self, obs, last_action, last_reward, hidden, mode: int,
+                 timeout: Optional[float] = None):
+        self._write_request(obs, last_action, last_reward, hidden, mode)
+        self._await_response(mode, timeout=timeout)
+        v = self.views
+        if mode == MODE_PEEK:
+            return v["q"], None
+        self._pending_resets.clear()
+        return v["q"], v["rsp_hidden"]
+
+    def _rpc(self, obs, last_action, last_reward, hidden, mode: int):
+        state = self.breaker.state
+        if state != CLOSED:
+            # peeks never probe: a peek cannot resync hidden, so closing
+            # the circuit off one would re-attach with stale server state
+            if (mode == MODE_PEEK or state == OPEN
+                    or not self.breaker.allow_attempt()):
+                return self._local(obs, last_action, last_reward, hidden,
+                                   mode)
+            # the half-open probe: ONE attempt, in resync mode, so a
+            # success re-attaches bit-exact from the fleet's carry.
+            # Probe with the COOLDOWN as its deadline, not the full RPC
+            # budget — a probe that blocks act_response_timeout (60 s
+            # default) every cooldown window would starve degraded-mode
+            # acting to a sliver of wall-clock during a long outage
+            try:
+                out = self._attempt(obs, last_action, last_reward, hidden,
+                                    MODE_RESYNC,
+                                    timeout=min(self.timeout,
+                                                self.breaker.cooldown))
+            except (ActTimeout, ActGarbled) as e:
+                log.warning("fleet%d: re-attach probe failed (%s) — "
+                            "circuit re-opens", self.src, e)
+                self.breaker.record_failure()
+                return self._local(obs, last_action, last_reward, hidden,
+                                   mode)
+            self.breaker.record_success()
+            return out
+        # circuit closed: bounded retries; any retry after a miss runs in
+        # resync mode because the failed attempt may have half-advanced
+        # the server state (served late, response lost)
+        eff = mode
+        for attempt in range(1, self.retry.attempts + 1):
+            try:
+                out = self._attempt(obs, last_action, last_reward, hidden,
+                                    eff)
+            except (ActTimeout, ActGarbled) as e:
+                if attempt >= self.retry.attempts:
+                    log.warning(
+                        "fleet%d: act RPC failed after %d attempts (%s)",
+                        self.src, attempt, e)
+                    self.breaker.record_failure()   # -> OPEN
+                    return self._local(obs, last_action, last_reward,
+                                       hidden, mode)
+                self.stats["act_retries"] += 1
+                if mode != MODE_PEEK:
+                    eff = MODE_RESYNC
+                time.sleep(self.retry.backoff(attempt))
+                continue
+            self.breaker.record_success()
+            return out
 
     def close(self) -> None:
         try:
@@ -288,12 +537,22 @@ class InferenceService:
         self._params = None
         self._param_version = 0
         self.tracer = None                # set by train(); spans optional
+        self.chaos = None                 # set by train(): the drop/garble
+                                          # response fault sites live here
         self.batches = 0
         self.lanes_served = 0
         self.last_batch_lanes = 0
         self.peeks = 0
         self.requests_corrupt = 0
         self.shard_resets = 0
+        self.partial_batches = 0          # batches serving < all attached
+                                          # fleets (a dead/slow/degraded
+                                          # fleet never holds the window
+                                          # hostage — the rest act on)
+        self.stale_requests = 0           # tokens superseded by a retry
+        self.resyncs = 0                  # MODE_RESYNC requests honoured
+        self.dropped_responses = 0        # chaos drop_act_response fires
+        self.garbled_responses = 0        # chaos garble_act_response fires
 
     # ------------------------------------------------------------ channels
     def make_channel(self, f: int) -> ActChannel:
@@ -384,21 +643,31 @@ class InferenceService:
         if ch is None or f in self._pending:
             return False
         try:
-            seq, commit = ch.req_q.get_nowait()
+            seq, mode = ch.req_q.get_nowait()
         except Empty:
             return False
         except Exception:
             return False   # retired channel / corrupted pipe: respawn path
+        if int(ch.views["req_seq"][0]) != seq:
+            # superseded by a retry: the fleet bumped its seq and is only
+            # waiting on the newest one — answering this token would act
+            # on a half-overwritten slab for a reply nobody consumes
+            self.stale_requests += 1
+            self.registry.inc("serve.stale_requests", fleet=str(f))
+            return True    # progress: the retry token is behind it
         if int(ch.views["req_crc"][0]) != act_request_crc(ch.views, seq,
-                                                          commit):
-            # garbled slab (chaos, torn producer): count + surface, but
-            # still serve — dropping the reply would wedge the lockstep
-            # fleet forever, and the experience CRC on the block channel
-            # independently protects the replay ring
+                                                          mode):
+            # garbled slab (chaos, or a retry tearing the slab under a
+            # stale in-flight token): DROP it.  Serving would act on
+            # garbage — and for a resync, load the corrupt sync_hidden
+            # over the shard — then stamp a VALID response CRC over the
+            # poisoned reply, which the fleet would adopt undetected.
+            # The fleet's bounded retry times out and resends clean
             self.requests_corrupt += 1
-            log.warning("fleet%d: act request %d failed CRC32 — serving "
-                        "anyway (counted)", f, seq)
-        self._pending[f] = (seq, bool(commit), ch)
+            log.warning("fleet%d: act request %d failed CRC32 — dropped "
+                        "(fleet retry resends clean)", f, seq)
+            return True
+        self._pending[f] = (seq, int(mode), ch)
         return True
 
     def serve_once(self, idle_sleep: float = 0.001) -> int:
@@ -413,10 +682,13 @@ class InferenceService:
             return 0
         # batch window: lockstep peers post within microseconds of each
         # other in steady state — a short wait turns F singleton batches
-        # into one cross-fleet batch
+        # into one cross-fleet batch.  The window is a hard per-batch
+        # deadline: a dead, slow, or circuit-open fleet that never posts
+        # cannot hold the others' acting hostage — the batch dispatches
+        # with its lanes masked (counted in serve.partial_batches)
         if len(self._pending) < F and self.cfg.inference_batch_window > 0:
-            deadline = time.monotonic() + self.cfg.inference_batch_window
-            while len(self._pending) < F and time.monotonic() < deadline:
+            window = Deadline(self.cfg.inference_batch_window)
+            while len(self._pending) < F and not window.expired:
                 if not any(self._drain(f) for f in range(F)):
                     time.sleep(0.0002)
         self._refresh_params()
@@ -435,14 +707,23 @@ class InferenceService:
                         # and now — the requester is dead, skip it
                         pend.remove(f)
                         continue
-                    _seq, commit, ch = item
+                    _seq, mode, ch = item
                     spec = self.specs[f]
                     lo, hi = spec.lo, spec.hi
                     v = ch.views
                     self.obs[lo:hi] = v["obs"]
                     self.last_action[lo:hi] = v["last_action"]
                     self.last_reward[lo:hi] = v["last_reward"]
-                    if commit:
+                    if mode == MODE_RESYNC:
+                        # re-attach/retry: the fleet's carry is the
+                        # authoritative recurrent state — load it over
+                        # the shard BEFORE the reset mask so the served
+                        # step continues bit-exact from wherever the
+                        # fleet (local path included) left off
+                        self.hidden[lo:hi] = v["sync_hidden"]
+                        self.resyncs += 1
+                        self.registry.inc("serve.resyncs", fleet=str(f))
+                    if mode != MODE_PEEK:
                         resets = np.nonzero(v["reset_mask"])[0]
                         if resets.size:
                             self.hidden[lo + resets] = 0.0
@@ -451,6 +732,10 @@ class InferenceService:
                 hidden_in = self.hidden.copy()
         if not pend:
             return 0
+        attached = sum(1 for ch in self.channels if ch is not None)
+        if len(pend) < attached:
+            self.partial_batches += 1
+            self.registry.inc("serve.partial_batches")
         with _span(tr, "serve.act"):
             q, new_hidden = self._act(self._params, self.obs,
                                       self.last_action, self.last_reward,
@@ -468,18 +753,37 @@ class InferenceService:
                     item = self._pending.pop(f, None)
                     if item is None:   # fleet retired mid-batch; see above
                         continue
-                    seq, commit, ch = item
+                    seq, mode, ch = item
                     spec = self.specs[f]
                     lo, hi = spec.lo, spec.hi
                     ch.views["q"][:] = q[lo:hi]
-                    if commit:
+                    if mode != MODE_PEEK:
                         ch.views["rsp_hidden"][:] = new_hidden[lo:hi]
                         # only pending lanes advance; idle fleets' state
                         # is untouched by the full-batch act
                         self.hidden[lo:hi] = new_hidden[lo:hi]
                     else:
                         self.peeks += 1
+                    # response CRC LAST — the reply is only valid once
+                    # the integrity word matches (the fleet retries on a
+                    # mismatch instead of consuming a torn reply)
+                    ch.views["rsp_crc"][0] = act_response_crc(
+                        ch.views, seq, mode)
                     lanes += hi - lo
+                    chaos = self.chaos
+                    if chaos is not None and chaos.garble_response():
+                        # chaos: flip response bytes AFTER the CRC landed
+                        # — the fleet's verification must catch it
+                        ch.views["q"][0, 0] = np.float32(
+                            ch.views["q"][0, 0]) + 1.0
+                        self.garbled_responses += 1
+                        self.registry.inc("serve.garbled_responses")
+                    if chaos is not None and chaos.drop_response():
+                        # chaos: lose the wakeup — the fleet's bounded
+                        # retry must re-request and get answered
+                        self.dropped_responses += 1
+                        self.registry.inc("serve.dropped_responses")
+                        continue
                     try:
                         ch.rsp_q.put(seq)
                     except Exception:
@@ -505,6 +809,11 @@ class InferenceService:
             requests_corrupt=self.requests_corrupt,
             shard_resets=self.shard_resets,
             param_version=self._param_version,
+            partial_batches=self.partial_batches,
+            stale_requests=self.stale_requests,
+            resyncs=self.resyncs,
+            dropped_responses=self.dropped_responses,
+            garbled_responses=self.garbled_responses,
         )
 
     def close(self) -> None:

@@ -97,6 +97,11 @@ class Telemetry:
                           1.0 if h.get("alive") else 0.0, thread=name)
             reg.counter_max("fabric.thread_restarts",
                             h.get("restarts", 0), thread=name)
+            if h.get("gave_up"):
+                # belt over the Supervisor's own on_giveup stamp (the
+                # log loop may be the thread that died — then only the
+                # callback path records it)
+                reg.counter_max("supervisor.gaveup", 1, thread=name)
         # chaos fires
         for kind, n in (entry.get("chaos") or {}).items():
             reg.counter_max("chaos.fires", n, kind=kind)
@@ -148,10 +153,33 @@ class Telemetry:
                                 svc.get("lanes_served", 0))
                 reg.counter_max("serve.requests_corrupt",
                                 svc.get("requests_corrupt", 0))
+                reg.counter_max("serve.partial_batches",
+                                svc.get("partial_batches", 0))
+                reg.counter_max("serve.stale_requests",
+                                svc.get("stale_requests", 0))
+                reg.counter_max("serve.resyncs", svc.get("resyncs", 0))
                 reg.set_gauge("serve.last_batch_lanes",
                               svc.get("last_batch_lanes", 0))
                 reg.set_gauge("serve.param_version",
                               svc.get("param_version", 0))
+            # degraded-mode resilience plane (utils/resilience.py): the
+            # fleets' act-RPC failover state merged from the stats slab
+            # plus the plane's param-staleness watchdog
+            res = fleet.get("resilience")
+            if res:
+                reg.counter_max("resilience.retries",
+                                res.get("retries", 0))
+                reg.counter_max("resilience.circuit_opens",
+                                res.get("circuit_opens", 0))
+                reg.counter_max("resilience.local_acts",
+                                res.get("local_acts", 0))
+                reg.set_gauge("resilience.degraded",
+                              1.0 if res.get("degraded") else 0.0)
+                reg.set_gauge("fleet.max_stale_params_s",
+                              res.get("max_stale_params_s", 0.0))
+                for f, st in enumerate(res.get("circuit_states", [])):
+                    reg.set_gauge("resilience.circuit_state", st,
+                                  fleet=str(f))
         # anakin fused-loop surface (train._train_anakin's log loop): the
         # transport is single-process by construction, so its counters
         # publish straight through the registry — no shm slab involved

@@ -52,6 +52,12 @@ FLEET_STAT_FIELDS: Tuple[Tuple[str, str], ...] = (
     ("param_version", "gauge"),
     ("incarnation", "gauge"),   # respawn generation — the merger's fold
                                 # trigger (module docstring)
+    # degraded-mode resilience counters (utils/resilience.py — the serve
+    # fleets' act-RPC failover state, exported as resilience.*)
+    ("act_retries", "counter"),
+    ("circuit_opens", "counter"),
+    ("local_acts", "counter"),
+    ("circuit_state", "gauge"),  # 0 closed / 1 open / 2 half-open
 )
 
 

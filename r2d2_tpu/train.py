@@ -383,11 +383,16 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
     holds the ENTIRE on-device loop state: ring, PER leaves, env
     phase/RNGs, agent LSTM carry, local buffers — ``--resume`` continues
     bit-exact), the learner heartbeat watchdog, and checkpoint cadences.
-    Not supported in this mode (documented in docs/OPERATIONS.md): chaos
-    injection (no fleet/shm fault sites exist), meshes (single-device
-    v1), and custom env factories (the env must be jittable; v1 ships the
-    fake env — any future jittable env plugs in at
-    ``envs/anakin.AnakinFakeEnv``'s four-method surface).
+    Chaos: the fleet/shm fault sites don't exist in this mode, but the
+    ``wedge_dispatch`` site does — it stalls one fused-dispatch harvest,
+    and ``cfg.dispatch_deadline`` (> 0) turns a dispatch that blows its
+    budget into a snapshot-then-clean-abort
+    (``metrics["dispatch_wedged"]``) instead of training on through a
+    flaky device.  Not supported in this mode (documented in
+    docs/OPERATIONS.md): meshes (single-device v1) and custom env
+    factories (the env must be jittable; v1 ships the fake env — any
+    future jittable env plugs in at ``envs/anakin.AnakinFakeEnv``'s
+    four-method surface).
     """
     from r2d2_tpu.learner.anakin import AnakinPlane, run_anakin_loop
     from r2d2_tpu.replay.device_ring import DeviceRing
@@ -455,8 +460,20 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                     "a cold ring", stacklevel=2)
 
     tracer = tracer or Tracer()
-    supervisor = Supervisor(max_restarts=3)
     telemetry = Telemetry(cfg, checkpoint_dir)
+    supervisor = Supervisor(
+        max_restarts=3,
+        on_giveup=lambda name: telemetry.registry.inc(
+            "supervisor.gaveup", thread=name))
+    chaos = None
+    if cfg.chaos_spec:
+        from r2d2_tpu.utils.chaos import ChaosInjector
+
+        # only the wedge_dispatch site exists in this transport; other
+        # armed kinds simply never reach an opportunity
+        chaos = ChaosInjector(cfg.chaos_spec, seed=cfg.seed)
+        if checkpointer is not None:
+            checkpointer.chaos = chaos
     stop_event = threading.Event()
     deadline = (time.time() + max_wall_seconds) if max_wall_seconds else None
 
@@ -490,8 +507,10 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
         age = heartbeat.age()
         stale = (cfg.learner_stall_timeout > 0
                  and age > cfg.learner_stall_timeout)
-        return dict(ok=not (supervisor.any_failed or stall["stalled"]
-                            or stale),
+        ok = not (supervisor.any_failed or stall["stalled"] or stale)
+        return dict(ok=ok,
+                    degraded=False,   # no fallback planes: ok or failing
+                    status="ok" if ok else "failing",
                     learner_heartbeat_age=age,
                     learner_stalled=stall["stalled"] or stale,
                     threads=supervisor.health())
@@ -578,7 +597,7 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                 metrics = run_anakin_loop(
                     learner, plane, stop=learner_stop, tracer=tracer,
                     snapshot_fn=(save_anakin_snapshot if want_full_save
-                                 else None))
+                                 else None), chaos=chaos)
         finally:
             stop_event.set()
             telemetry.close_exporter()
@@ -587,8 +606,11 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
         # drain-then-save epilogue: the learner state was saved by
         # run_anakin_loop's final _save; persist the on-device loop state
         # next to it so --resume continues warm (ring, RNGs, env phase,
-        # LSTM carry — no cold restart)
-        if want_full_save:
+        # LSTM carry — no cold restart).  A wedged abort already parked
+        # its snapshot inside the loop (bounded, on a hard wedge) —
+        # re-saving here would read the same wedged device UNBOUNDED on
+        # the main thread, trading the clean abort back for a hang
+        if want_full_save and not metrics.get("dispatch_wedged"):
             save_anakin_snapshot(learner.num_updates)
 
         metrics.update(buffer_size=plane.fill, logs=list(logs),
@@ -599,6 +621,8 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                        trace=tracer.snapshot(), health=supervisor.health(),
                        telemetry_port=telemetry.port,
                        fabric_failed=supervisor.any_failed)
+        if chaos is not None:
+            metrics["chaos"] = chaos.counts()
         return metrics
     finally:
         telemetry.close()
@@ -693,8 +717,14 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     checkpointer = sys["checkpointer"]
     plane = sys["plane"]
     tracer = tracer or Tracer()
-    supervisor = Supervisor(max_restarts=max_thread_restarts)
     telemetry = Telemetry(cfg, checkpoint_dir)
+    # a thread exhausting its restart budget is stamped straight into the
+    # registry by the supervisor itself — the log loop (the usual
+    # absorption path) may be the very thread that died
+    supervisor = Supervisor(
+        max_restarts=max_thread_restarts,
+        on_giveup=lambda name: telemetry.registry.inc(
+            "supervisor.gaveup", thread=name))
 
     chaos = None
     if cfg.chaos_spec:
@@ -709,10 +739,14 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         # the plane's counters (respawns, ingest histogram, serve shard
         # resets, slab-merged actor stats) land in the run's namespace
         plane.set_registry(telemetry.registry)
+        # fault sites owned by the plane's own loops (freeze_service /
+        # stall_pump) and the service's scatter (drop/garble response)
+        plane.chaos = chaos
         if plane.service is not None:
             # serve loop spans (assemble/act/scatter) + batch-size gauge
             # land in the same tracer snapshot as every other stage
             plane.service.tracer = tracer
+            plane.service.chaos = chaos
 
     stop_event = threading.Event()
     deadline = (time.time() + max_wall_seconds) if max_wall_seconds else None
@@ -802,11 +836,15 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         maxlen=cfg.log_history_cap)
 
     def healthz() -> Dict[str, Any]:
-        """The /healthz verdict: ok=False on any fabric-failing signal
-        OR a heartbeat past its stall budget (the exporter keeps
-        answering while the learner is merely frozen, so an external
-        prober sees the stall the moment it exceeds the budget — before
-        the watchdog has necessarily fired)."""
+        """The /healthz verdict — three states (docs/OBSERVABILITY.md):
+        ``ok`` (everything green), ``degraded`` (still serving HTTP 200,
+        but a plane is running on its fallback path — an open act
+        circuit, params stale past the budget), and ``failing`` (HTTP
+        503: supervisor giveup, failed fleet plane, heartbeat past its
+        stall budget).  The exporter keeps answering while the learner
+        is merely frozen, so an external prober sees the stall the
+        moment it exceeds the budget — before the watchdog has
+        necessarily fired."""
         age = heartbeat.age()
         stale = (cfg.learner_stall_timeout > 0
                  and age > cfg.learner_stall_timeout)
@@ -817,10 +855,16 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             learner_stalled=stall["stalled"] or stale,
             threads=supervisor.health(),
         )
+        degraded = False
         if plane is not None:
             h = plane.health()
             out["fleet"] = dict(fleets=h["fleets"], alive=h["alive"],
-                                restarts=h["restarts"], failed=h["failed"])
+                                restarts=h["restarts"], failed=h["failed"],
+                                resilience=h["resilience"])
+            degraded = bool(h["resilience"].get("degraded"))
+        out["degraded"] = degraded and out["ok"]
+        out["status"] = ("failing" if not out["ok"]
+                         else "degraded" if degraded else "ok")
         return out
 
     def log_loop():

@@ -17,6 +17,30 @@ recovery path the fabric claims to have can be exercised under load:
 - ``freeze_learner``— sleep inside the learner's stop-poll for ``dur``
                       seconds (the heartbeat watchdog must detect the
                       stall and stop the fabric).
+- ``freeze_service``— sleep inside the serve-plane's ``inference_serve``
+                      fabric loop for ``dur`` seconds: every serve-mode
+                      fleet's act RPCs start timing out, their circuit
+                      breakers must open and the fleets must degrade to
+                      local inference (utils/resilience.py), then
+                      re-attach after the thaw — zero fleet deaths.
+- ``drop_act_response``   — the service serves a batch but never posts
+                      one fleet's response token (simulates a lost
+                      wakeup); the fleet's bounded retry must re-request
+                      and be answered, never wedging the lockstep fleet.
+- ``garble_act_response`` — flip bytes inside one fleet's response
+                      region AFTER its CRC32 was written; the fleet must
+                      detect the mismatch and retry (bounded).
+- ``stall_pump``    — sleep inside the param-pump fabric loop for
+                      ``dur`` seconds: fleets keep training on frozen
+                      weights, which the staleness watchdog must surface
+                      as ``fleet.stale_params_s`` / a degraded health
+                      verdict instead of silence.
+- ``wedge_dispatch``— (anakin transport) stall the fused-loop harvest
+                      for ``dur`` seconds, simulating a wedged device
+                      dispatch; the bounded dispatch deadline
+                      (``cfg.dispatch_deadline``) must snapshot-then-
+                      abort instead of training on through a flaky
+                      device or hanging forever.
 
 Spec grammar — semicolon-separated ``kind[:key=val[,key=val...]]``::
 
@@ -28,7 +52,8 @@ Per-kind firing controls (an *opportunity* is one call site visit):
 - ``every=<int>`` fire on every Nth opportunity
 - ``at=<int>``    fire exactly once, on the Nth opportunity
 - ``n=<int>``     cap total fires (default: 1 for ``at``, unlimited else)
-- ``dur=<float>`` freeze duration in seconds (``freeze_learner`` only)
+- ``dur=<float>`` freeze/stall duration in seconds (``freeze_learner``,
+                  ``freeze_service``, ``stall_pump``, ``wedge_dispatch``)
 
 Everything is deterministic given (spec, seed): each kind gets its own
 counter and a PCG64 stream seeded from (seed, kind), so a chaos soak is
@@ -45,7 +70,11 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_KINDS = ("kill_fleet", "garble_block", "truncate_ckpt", "freeze_learner")
+# order matters: each kind's RNG stream is seeded from (seed, index), so
+# append new kinds at the END to keep existing soak replays stable
+_KINDS = ("kill_fleet", "garble_block", "truncate_ckpt", "freeze_learner",
+          "freeze_service", "drop_act_response", "garble_act_response",
+          "stall_pump", "wedge_dispatch")
 
 
 def parse_spec(spec: str) -> Dict[str, Dict[str, float]]:
@@ -168,3 +197,36 @@ class ChaosInjector:
         (0.0 = no freeze injected)."""
         prm = self.fire("freeze_learner")
         return float(prm.get("dur", 2.0)) if prm else 0.0
+
+    def service_freeze_seconds(self) -> float:
+        """Seconds the ``inference_serve`` fabric loop should sleep (0.0
+        = no freeze) — the serve-plane failover drill: the fleets' act
+        RPCs must time out, open their circuits and degrade to local
+        inference until the thaw.  One opportunity per SERVED batch (not
+        per idle poll), so ``at=N`` lands the freeze under real traffic
+        rather than during spawn/warm-up."""
+        prm = self.fire("freeze_service")
+        return float(prm.get("dur", 2.0)) if prm else 0.0
+
+    def pump_stall_seconds(self) -> float:
+        """Seconds the param-pump fabric loop should sleep this iteration
+        (0.0 = no stall) — the staleness-watchdog drill."""
+        prm = self.fire("stall_pump")
+        return float(prm.get("dur", 2.0)) if prm else 0.0
+
+    def dispatch_wedge_seconds(self) -> float:
+        """Seconds the anakin harvest should stall this dispatch (0.0 =
+        no wedge) — the bounded dispatch-deadline drill."""
+        prm = self.fire("wedge_dispatch")
+        return float(prm.get("dur", 2.0)) if prm else 0.0
+
+    def drop_response(self) -> bool:
+        """One opportunity per served response token: True = the service
+        must NOT post this token (the fleet's bounded retry recovers)."""
+        return self.fire("drop_act_response") is not None
+
+    def garble_response(self) -> bool:
+        """One opportunity per served response: True = the service flips
+        response bytes AFTER the CRC landed (fleet-side CRC verification
+        must catch it and retry)."""
+        return self.fire("garble_act_response") is not None

@@ -125,11 +125,25 @@ class Supervisor:
     treats that as a stop condition — the reference would simply hang).
     """
 
-    def __init__(self, max_restarts: int = 3, backoff: float = 0.5):
+    def __init__(self, max_restarts: int = 3, backoff: float = 0.5,
+                 on_giveup: Optional[Callable[[str], None]] = None):
         self.max_restarts = max_restarts
         self.backoff = backoff
         self.threads: Dict[str, SupervisedThread] = {}
         self._failed = threading.Event()
+        # optional observer invoked (with the thread name) when a thread
+        # exhausts its budget — train() wires it to the telemetry
+        # registry so the give-up is stamped (``supervisor.gaveup``)
+        # even though the log loop may be the very thread that died
+        self._on_giveup_cb = on_giveup
+
+    def _giveup(self, name: str) -> None:
+        self._failed.set()
+        if self._on_giveup_cb is not None:
+            try:
+                self._on_giveup_cb(name)
+            except Exception:  # an observer must never mask the failure
+                pass
 
     def start(self, name: str, loop: Callable[[], None]) -> SupervisedThread:
         if name in self.threads:
@@ -140,7 +154,7 @@ class Supervisor:
                 f"thread {name!r} is already supervised; stop() it first "
                 "or pick a distinct name")
         t = SupervisedThread(name, loop, self.max_restarts, self.backoff,
-                             on_giveup=lambda _n: self._failed.set())
+                             on_giveup=self._giveup)
         self.threads[name] = t
         t.start()
         return t

@@ -132,11 +132,12 @@ def test_serve_mode_blocks_bit_exact_vs_local():
         svc.close()
 
 
-def test_serve_request_crc_detects_garbled_slab():
+def test_serve_request_crc_drops_garbled_slab():
     """A garbled act request (chaos, torn write) must be detected by the
-    CRC32 integrity word and COUNTED — but still served: dropping the
-    reply would wedge the lockstep fleet forever, and the replay ring is
-    independently protected by the block channel's own CRC."""
+    CRC32 integrity word, COUNTED, and DROPPED — serving it would stamp a
+    valid response CRC over a garbage-derived reply (and a garbled resync
+    would poison the server-resident hidden).  The fleet's bounded retry
+    owns recovery: its clean resend must be answered normally."""
     cfg = _serve_cfg()
     net = create_network(cfg, A)
     params = init_params(cfg, net, jax.random.PRNGKey(0))
@@ -151,15 +152,29 @@ def test_serve_request_crc_detects_garbled_slab():
         v["last_action"][:] = 0.0
         v["last_reward"][:] = 0.0
         v["reset_mask"][:] = 1
+        v["req_seq"][0] = 1
         v["req_crc"][0] = act_request_crc(v, 1, True)
         v["obs"][0, 0] ^= 0xFF   # garble AFTER the CRC landed
         ch.req_q.put((1, 1))
-        deadline = time.time() + 30
-        while svc.batches == 0 and time.time() < deadline:
+        hidden_before = svc.hidden.copy()
+        for _ in range(20):
             svc.serve_once(idle_sleep=0.0)
         assert svc.requests_corrupt == 1
         assert svc.health()["requests_corrupt"] == 1
-        assert ch.rsp_q.get(timeout=10) == 1   # still answered
+        assert svc.batches == 0                # dropped, not served
+        assert ch.rsp_q.empty()                # no reply to consume
+        # server state untouched by the garbled request
+        np.testing.assert_array_equal(svc.hidden, hidden_before)
+        # the fleet's retry resends clean (bumped seq) and is answered
+        v["obs"][0, 0] ^= 0xFF                 # un-garble
+        v["req_seq"][0] = 2
+        v["req_crc"][0] = act_request_crc(v, 2, 1)
+        ch.req_q.put((2, 1))
+        deadline = time.time() + 30
+        while svc.batches == 0 and time.time() < deadline:
+            svc.serve_once(idle_sleep=0.0)
+        assert svc.batches == 1
+        assert ch.rsp_q.get(timeout=10) == 2
     finally:
         svc.close()
 

@@ -518,3 +518,69 @@ def test_sigterm_resume_serve_mode_end_to_end(tmp_path):
     assert m2["num_updates"] >= m["num_updates"] + 3
     assert not m2["fabric_failed"]
     assert np.isfinite(m2["mean_loss"])
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_sigterm_resume_with_circuit_open_at_signal_time(tmp_path):
+    """ISSUE 7 acceptance: SIGTERM a serve-mode run WHILE the fleets'
+    act circuits are open (service frozen by chaos, acting degraded to
+    the local fallback) — degraded-mode state is deliberately NOT
+    persisted; on resume the circuits are *safely re-probed*: fleets
+    spawn with closed circuits, the fleet-authoritative hidden carry is
+    restored from the actor snapshots into BOTH the actors and the
+    server shards (the same payload — so whichever path serves the next
+    act, the stream continues from the exact saved carry), and training
+    continues warm.  Documented in docs/OPERATIONS.md.  slow: two rounds
+    of fleet spawns."""
+    from test_actor_procs import make_fake_env
+
+    ck_dir = str(tmp_path / "ck")
+    cfg = make_test_config(game_name="Fake", num_actors=2, actor_fleets=2,
+                           actor_transport="process",
+                           actor_inference="serve",
+                           training_steps=100000, log_interval=0.2,
+                           save_interval=10 ** 8,
+                           act_response_timeout=0.5,
+                           # one opportunity per served batch; freeze
+                           # long enough that the drain lands inside
+                           # the degraded window
+                           chaos_spec="freeze_service:at=50,dur=30")
+
+    def sink(entry):
+        res = ((entry.get("fleet") or {}).get("resilience")) or {}
+        # signal ONLY once a circuit is genuinely open and the learner
+        # has trained — the drain then happens in degraded mode
+        if res.get("circuits_open", 0) > 0 and entry["training_steps"] > 0:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    m = train(cfg, env_factory=make_fake_env, checkpoint_dir=ck_dir,
+              verbose=False, log_sink=sink, max_wall_seconds=300)
+    assert m["chaos"]["freeze_service"] == 1, "the freeze never fired"
+    res = m["fleet_health"]["resilience"]
+    assert res["circuit_opens"] >= 1, "no circuit opened before SIGTERM"
+    assert res["local_acts"] > 0
+    assert m["fleet_health"]["restarts"] == [0, 0]   # zero fleet deaths
+    assert not m["fabric_failed"]
+
+    ck = Checkpointer(ck_dir)
+    assert ck.latest_step() is not None and ck.replay_steps()
+    _, _, actor_snaps = ck.restore_replay()
+    assert actor_snaps is not None
+    assert sum(s is not None for s in actor_snaps) >= 1
+
+    # resume WITHOUT chaos: circuits re-probe against a live service and
+    # training continues bit-warm from the degraded-phase snapshot (the
+    # generous timeout keeps a loaded-host act compile from opening a
+    # circuit — this leg asserts the CLEAN re-attach)
+    m2 = train(cfg.replace(training_steps=m["num_updates"] + 3,
+                           chaos_spec="", act_response_timeout=60.0),
+               env_factory=make_fake_env, checkpoint_dir=ck_dir,
+               resume=True, verbose=False, max_wall_seconds=300)
+    assert m2["restored_replay"]
+    assert m2["num_updates"] >= m["num_updates"] + 3
+    assert not m2["fabric_failed"]
+    assert np.isfinite(m2["mean_loss"])
+    # the resumed fleets attached cleanly: no circuit ever opened
+    assert m2["fleet_health"]["resilience"]["circuit_opens"] == 0
+    assert m2["fleet_health"]["restarts"] == [0, 0]

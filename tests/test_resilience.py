@@ -1,0 +1,718 @@
+"""Degraded-mode resilience layer (ISSUE 7): Deadline / RetryPolicy /
+CircuitBreaker primitives, serve-plane failover (fleet-local fallback
+inference, half-open re-attach with hidden resync — bit-exact across the
+whole failover cycle), service-side hardening (partial batches, stale
+request drops, dropped/garbled response recovery), the param-staleness
+watchdog, the anakin wedge_dispatch snapshot-then-abort drill, and the
+three-state /healthz contract.
+"""
+import multiprocessing as mp
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.actor import VectorActor, make_act_fn
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane
+from r2d2_tpu.parallel.inference_service import RemoteActClient
+from r2d2_tpu.utils.chaos import ChaosInjector
+from r2d2_tpu.utils.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    Deadline,
+    RetryPolicy,
+)
+from r2d2_tpu.utils.store import ParamStore
+
+A = 4
+
+
+def make_fake_env(cfg, seed):
+    """Module-level (picklable) factory for the spawn children."""
+    return FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                        seed=seed, episode_len=32)
+
+
+def _serve_cfg(**kw):
+    base = dict(num_actors=2, actor_transport="process",
+                actor_inference="serve")
+    base.update(kw)
+    return make_test_config(**base)
+
+
+def _long_episode_envs(cfg, n):
+    return [FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                         seed=i, episode_len=500) for i in range(n)]
+
+
+# ---------------------------------------------------------- primitives
+
+def test_deadline_budget_and_unbounded():
+    d = Deadline(0.15)
+    assert not d.expired
+    assert 0 < d.remaining() <= 0.15
+    assert d.poll_timeout(0.2) <= 0.15 + 1e-6
+    time.sleep(0.2)
+    assert d.expired
+    assert d.remaining() == 0.0
+    assert d.poll_timeout(0.2) == 0.001     # floored non-busy poll
+    # budget <= 0 means unbounded
+    u = Deadline(0.0)
+    time.sleep(0.01)
+    assert not u.expired
+    assert u.remaining() == float("inf")
+    assert u.remaining(0.2) == 0.2
+    assert u.poll_timeout(0.2) == 0.2
+
+
+def test_retry_policy_bounded_jittered_exponential():
+    p = RetryPolicy(attempts=4, base=0.1, max_delay=10.0, jitter=0.2,
+                    seed=3)
+    delays = [p.backoff(i) for i in range(1, p.attempts)]
+    assert len(delays) == 3                 # attempts - 1 sleeps
+    for i, d in enumerate(delays):
+        nominal = 0.1 * 2 ** i
+        assert 0.8 * nominal - 1e-9 <= d <= 1.2 * nominal + 1e-9
+    # deterministic given the seed
+    p2 = RetryPolicy(attempts=4, base=0.1, max_delay=10.0, jitter=0.2,
+                     seed=3)
+    assert delays == [p2.backoff(i) for i in range(1, p2.attempts)]
+    # cap applies before jitter
+    pc = RetryPolicy(attempts=8, base=1.0, max_delay=1.5, jitter=0.0)
+    assert max(pc.backoff(i) for i in range(1, pc.attempts)) == 1.5
+    with pytest.raises(ValueError, match="attempts"):
+        RetryPolicy(attempts=0)
+
+
+def test_circuit_breaker_state_machine_and_telemetry():
+    transitions = []
+    b = CircuitBreaker(name="t", failure_threshold=2, cooldown=0.2,
+                       on_transition=lambda n, o, s: transitions.append(
+                           (n, o, s)))
+    assert b.state == CLOSED and b.allow_attempt()
+    b.record_failure()
+    assert b.state == CLOSED                # below threshold
+    b.record_failure()
+    assert b.state == OPEN
+    assert transitions == [("t", CLOSED, OPEN)]
+    assert not b.allow_attempt()            # open: local fallback
+    time.sleep(0.25)
+    assert b.state == HALF_OPEN             # cooldown elapsed (lazy)
+    # the lazy flip still fires on_transition — the circuit_state gauge
+    # must be able to show all three documented states
+    assert transitions[-1] == ("t", OPEN, HALF_OPEN)
+    assert b.allow_attempt()                # THE probe slot
+    assert not b.allow_attempt()            # only one probe per window
+    b.record_failure()                      # probe failed -> re-open
+    assert b.state == OPEN and b.opens == 2
+    time.sleep(0.25)
+    assert b.allow_attempt()
+    b.record_success()                      # probe succeeded -> closed
+    assert b.state == CLOSED
+    assert transitions == [("t", CLOSED, OPEN),
+                           ("t", OPEN, HALF_OPEN),
+                           ("t", HALF_OPEN, OPEN),
+                           ("t", OPEN, HALF_OPEN),
+                           ("t", HALF_OPEN, CLOSED)]
+    snap = b.snapshot()
+    assert snap["opens"] == 2 and snap["probes"] == 2
+    assert snap["state_name"] == "closed"
+    # consecutive-failure count resets on success
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == CLOSED
+
+
+def test_config_act_response_timeout_and_dispatch_deadline_validation():
+    with pytest.raises(ValueError, match="act_response_timeout"):
+        make_test_config(act_response_timeout=0.0)
+    with pytest.raises(ValueError, match="act_response_timeout"):
+        make_test_config(act_response_timeout=-1.0)
+    with pytest.raises(ValueError, match="dispatch_deadline"):
+        make_test_config(dispatch_deadline=-0.1)
+    assert make_test_config(act_response_timeout=2.5).act_response_timeout \
+        == 2.5
+    assert make_test_config(dispatch_deadline=0.0).dispatch_deadline == 0.0
+
+
+def test_cli_act_response_timeout_flag():
+    from r2d2_tpu import cli as cli_mod
+
+    # an invalid value must fail loudly at the parser (Config validation)
+    with pytest.raises(SystemExit):
+        cli_mod.main(["train", "--preset", "test", "--game", "Fake",
+                      "--act-response-timeout", "0", "--sync"])
+
+    # --set override path resolves the field (config-integrity liveness)
+    class Args:
+        preset = "test"
+        game = None
+        actors = None
+        seed = None
+        training_steps = None
+        overrides = [("act_response_timeout", 3.5)]
+        actor_transport = None
+        actor_inference = None
+
+    assert cli_mod.build_config(Args()).act_response_timeout == 3.5
+
+
+# ------------------------------------------- new chaos kinds parse/fire
+
+def test_chaos_new_kinds_parse_and_helpers():
+    from r2d2_tpu.utils.chaos import parse_spec
+
+    spec = parse_spec("freeze_service:at=2,dur=4;stall_pump:at=1,dur=3;"
+                      "drop_act_response:every=2;"
+                      "garble_act_response:at=1;wedge_dispatch:at=1,dur=9")
+    assert spec["freeze_service"] == {"at": 2.0, "dur": 4.0}
+    # config validation accepts the new kinds
+    assert make_test_config(
+        chaos_spec="freeze_service:at=1,dur=2").chaos_spec
+
+    inj = ChaosInjector("freeze_service:at=2,dur=4;stall_pump:at=1,dur=3;"
+                        "drop_act_response:every=2;"
+                        "garble_act_response:at=1;"
+                        "wedge_dispatch:at=1,dur=9", seed=0)
+    assert inj.service_freeze_seconds() == 0.0     # opportunity 1
+    assert inj.service_freeze_seconds() == 4.0     # at=2 fires once
+    assert inj.service_freeze_seconds() == 0.0
+    assert inj.pump_stall_seconds() == 3.0
+    assert inj.pump_stall_seconds() == 0.0
+    assert [inj.drop_response() for _ in range(4)] == [False, True,
+                                                      False, True]
+    assert inj.garble_response() is True
+    assert inj.garble_response() is False
+    assert inj.dispatch_wedge_seconds() == 9.0
+    assert inj.counts()["wedge_dispatch"] == 1
+
+
+# ------------------------------------------- serve-plane failover cycle
+
+def _pump_while(svc, fn):
+    """Run ``fn`` (an actor burst) in a thread while pumping the service
+    from this one — the in-process stand-in for the fabric's
+    ``inference_serve`` loop."""
+    done = threading.Event()
+    err = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.time() + 240
+    while not done.is_set() and time.time() < deadline:
+        svc.serve_once(idle_sleep=0.0)
+    t.join(10)
+    assert done.is_set(), "actor burst never finished (wedged?)"
+    if err:
+        raise err[0]
+
+
+@pytest.mark.timeout(600)
+def test_failover_cycle_blocks_bit_exact_and_reattach():
+    """THE failover acceptance invariant, as a deterministic three-phase
+    drill: (A) attached — normal serve-mode acting; (B) frozen — the
+    service stops serving entirely, the fleet's circuit opens after
+    bounded retries and acting degrades to the local twin on the pumped
+    params; (C) thawed — the half-open probe re-attaches with a hidden
+    resync.  The ENTIRE block stream (before, during, and after the
+    failover) must be bit-exact vs a pure local-inference run, and the
+    server's hidden must re-converge to the fleet's authoritative
+    carry."""
+    cfg = _serve_cfg(max_episode_steps=20,      # caps fire: peeks covered
+                     act_response_timeout=0.3)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+
+    got_local, got_serve = [], []
+    a1 = VectorActor(cfg, _long_episode_envs(cfg, 2), [0.4, 0.3],
+                     make_act_fn(cfg, net), ParamStore(params),
+                     sink=lambda b, p, e: got_local.append((b, p.copy(), e)),
+                     rng=np.random.default_rng(5))
+    a1.run(max_steps=57)
+
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    svc = plane.service
+    svc.start(ParamStore(params))
+    ch = svc.make_channel(0)
+    # the degraded-mode kit a real fleet subprocess gets: the same param
+    # snapshot in a local store + the local act twin factory
+    client = RemoteActClient(
+        cfg, A, 2, ch.producer_info(), mp.get_context("spawn").Event(),
+        param_store=ParamStore(jax.device_get(params)),
+        local_act_factory=lambda: make_act_fn(cfg, net))
+    a2 = VectorActor(cfg, _long_episode_envs(cfg, 2), [0.4, 0.3], client,
+                     ParamStore(),
+                     sink=lambda b, p, e: got_serve.append((b, p.copy(), e)),
+                     rng=np.random.default_rng(5))
+    try:
+        # warm the service's act compile through a no-state-advance peek
+        # so phase A's tight response deadline never races XLA compile
+        zero = (np.zeros((2, *cfg.stored_obs_shape), np.uint8),
+                np.zeros((2, A), np.float32), np.zeros(2, np.float32),
+                np.zeros((2, 2, cfg.lstm_layers, cfg.hidden_dim),
+                         np.float32))
+        _pump_while(svc, lambda: client.peek(None, *zero))
+
+        # phase A — attached: 20 lockstep steps through the service
+        _pump_while(svc, lambda: a2.run(max_steps=20))
+        assert client.breaker.state == CLOSED
+        assert client.stats["local_acts"] == 0
+
+        # phase B — FROZEN service (nobody pumps serve_once): the first
+        # act exhausts its bounded retries, the circuit opens, and the
+        # remaining steps run on the local twin — no fleet death, no
+        # unbounded wait, blocks keep flowing
+        a2.run(max_steps=17)
+        # the circuit opened (half-open probes may have failed against
+        # the still-frozen service and re-opened it — each counted)
+        assert client.stats["circuit_opens"] >= 1
+        assert client.breaker.state != CLOSED
+        assert client.stats["local_acts"] == 17   # every step acted local
+        assert client.stats["act_retries"] >= 1
+
+        # phase C — thaw: after the cooldown the next commit is the
+        # half-open probe (resync mode); it re-attaches and the rest of
+        # the run is served remotely again
+        local_b = client.stats["local_acts"]
+        time.sleep(client.breaker.cooldown + 0.05)
+        _pump_while(svc, lambda: a2.run(max_steps=20))
+        assert client.breaker.state == CLOSED, "never re-attached"
+        assert svc.resyncs >= 1, "re-attach probe never resynced hidden"
+        # phase B's abandoned request tokens were dropped as superseded
+        # (the fleet only waits on its newest seq), never answered blind
+        assert svc.stale_requests >= 1
+        # re-attach happened early in phase C: at most a couple of steps
+        # ran local before a probe landed on the live service
+        assert client.stats["local_acts"] <= local_b + 5
+
+        # bit-exact across the WHOLE cycle (the ISSUE 7 acceptance gate)
+        assert len(got_local) == len(got_serve) > 0
+        for (b1, p1, e1), (b2, p2, e2) in zip(got_local, got_serve):
+            for f in ("obs", "last_action", "last_reward", "action",
+                      "n_step_reward", "n_step_gamma", "hidden",
+                      "burn_in_steps", "learning_steps", "forward_steps"):
+                np.testing.assert_array_equal(getattr(b1, f),
+                                              getattr(b2, f), err_msg=f)
+            np.testing.assert_array_equal(p1, p2)
+            assert e1 == e2
+        # post-re-attach server hidden is the fleet's authoritative carry
+        np.testing.assert_array_equal(a1.hidden, a2.hidden)
+        np.testing.assert_array_equal(svc.hidden, a2.hidden)
+    finally:
+        client.close()
+        svc.close()
+
+
+@pytest.mark.timeout(600)
+def test_drop_and_garble_response_recovered_by_bounded_retry():
+    """The drop_act_response / garble_act_response chaos sites: a lost
+    response token and a garbled response payload must both be absorbed
+    by the client's bounded retry (counted), never wedge the lockstep
+    fleet, and leave the block stream bit-exact (retries resync the
+    server hidden from the fleet's carry, so a half-served attempt can
+    never double-advance state)."""
+    cfg = _serve_cfg(act_response_timeout=0.25)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+
+    got_local, got_serve = [], []
+    a1 = VectorActor(cfg, _long_episode_envs(cfg, 2), [0.4, 0.3],
+                     make_act_fn(cfg, net), ParamStore(params),
+                     sink=lambda b, p, e: got_local.append((b, p.copy(), e)),
+                     rng=np.random.default_rng(5))
+    a1.run(max_steps=41)
+
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    svc = plane.service
+    svc.start(ParamStore(params))
+    svc.chaos = ChaosInjector(
+        "drop_act_response:at=7;garble_act_response:at=15", seed=0)
+    ch = svc.make_channel(0)
+    client = RemoteActClient(
+        cfg, A, 2, ch.producer_info(), mp.get_context("spawn").Event(),
+        param_store=ParamStore(jax.device_get(params)),
+        local_act_factory=lambda: make_act_fn(cfg, net))
+    a2 = VectorActor(cfg, _long_episode_envs(cfg, 2), [0.4, 0.3], client,
+                     ParamStore(),
+                     sink=lambda b, p, e: got_serve.append((b, p.copy(), e)),
+                     rng=np.random.default_rng(5))
+    try:
+        done = threading.Event()
+        err = []
+
+        def run():
+            try:
+                a2.run(max_steps=41)
+            except BaseException as e:
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.time() + 240
+        while not done.is_set() and time.time() < deadline:
+            svc.serve_once(idle_sleep=0.0)
+        t.join(10)
+        assert done.is_set(), "a dropped/garbled response wedged the fleet"
+        if err:
+            raise err[0]
+
+        assert svc.dropped_responses == 1
+        assert svc.garbled_responses == 1
+        assert client.stats["act_retries"] >= 2     # one per injected fault
+        assert client.breaker.state == CLOSED       # retries sufficed
+        assert client.stats["circuit_opens"] == 0
+
+        assert len(got_local) == len(got_serve) > 0
+        for (b1, p1, e1), (b2, p2, e2) in zip(got_local, got_serve):
+            for f in ("obs", "action", "n_step_reward", "hidden"):
+                np.testing.assert_array_equal(getattr(b1, f),
+                                              getattr(b2, f), err_msg=f)
+            np.testing.assert_array_equal(p1, p2)
+            assert e1 == e2
+        np.testing.assert_array_equal(a1.hidden, a2.hidden)
+    finally:
+        client.close()
+        svc.close()
+
+
+# ------------------------------------------------- service hardening
+
+def test_partial_batch_counted_when_a_fleet_never_posts():
+    """One fleet posts, the other never does: after the batch window the
+    act must dispatch anyway (masked lanes) and count a partial batch —
+    a dead fleet cannot hold the lockstep window hostage."""
+    from r2d2_tpu.parallel.inference_service import act_request_crc
+
+    cfg = _serve_cfg(num_actors=4, actor_fleets=2,
+                     inference_batch_window=0.05)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    plane = ProcessFleetPlane(cfg, A, make_fake_env,
+                              [0.4, 0.3, 0.2, 0.1])
+    svc = plane.service
+    svc.start(ParamStore(params))
+    ch0 = svc.make_channel(0)
+    svc.make_channel(1)                     # attached but silent
+    try:
+        v = ch0.views
+        v["obs"][:] = 7
+        v["last_action"][:] = 0.0
+        v["last_reward"][:] = 0.0
+        v["reset_mask"][:] = 0
+        v["req_seq"][0] = 1
+        v["req_crc"][0] = act_request_crc(v, 1, 1)
+        ch0.req_q.put((1, 1))
+        t0 = time.monotonic()
+        deadline = time.time() + 60
+        while svc.batches == 0 and time.time() < deadline:
+            svc.serve_once(idle_sleep=0.0)
+        assert svc.batches == 1
+        assert svc.partial_batches == 1
+        assert svc.health()["partial_batches"] == 1
+        assert ch0.rsp_q.get(timeout=10) == 1
+        # the window bounded the wait (one window, not a hang)
+        assert time.monotonic() - t0 < 30
+    finally:
+        svc.close()
+
+
+def test_param_staleness_watchdog_degrades_health():
+    """A fleet reporting an older param version than the newest published
+    one accrues stale_params_s from the version edge; past the budget the
+    plane's resilience verdict (and /healthz) degrades — a dead pump can
+    no longer mean silent training on frozen weights."""
+    from r2d2_tpu.telemetry.slab import StatsSlabWriter
+
+    cfg = _serve_cfg(actor_fleets=2)
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    plane.param_store = ParamStore({"w": np.zeros(2)})   # version 1
+    plane.stale_params_budget = 0.15
+
+    w0 = StatsSlabWriter(plane.stats_slab.writer_info(0))
+    w1 = StatsSlabWriter(plane.stats_slab.writer_info(1))
+    try:
+        # before a fleet's FIRST stats publication its slot reads
+        # param_version=0 — spawn/compile warm-up, not a dead pump; the
+        # clock must not arm or every cold start slower than the budget
+        # would flip /healthz to "degraded"
+        res = plane.resilience_health()
+        assert res["stale_params_s"] == [0.0, 0.0]
+        assert not res["degraded"]
+        w0.publish(dict(env_steps=10, param_version=1, incarnation=0))
+        w1.publish(dict(env_steps=10, param_version=1, incarnation=0))
+        res = plane.resilience_health()
+        assert res["stale_params_s"] == [0.0, 0.0]
+        assert not res["degraded"]
+
+        # the learner publishes version 2; fleet 1's pump never delivers
+        plane.param_store.publish({"w": np.ones(2)})
+        w0.publish(dict(env_steps=20, param_version=2, incarnation=0))
+        w1.publish(dict(env_steps=20, param_version=1, incarnation=0))
+        res = plane.resilience_health()
+        assert res["stale_params_s"][0] == 0.0
+        assert res["max_stale_params_s"] >= 0.0
+        time.sleep(0.25)                     # cross the budget
+        res = plane.resilience_health()
+        assert res["stale_params_s"][1] > plane.stale_params_budget
+        assert res["degraded"]
+        # the learner publishing AGAIN must not reset fleet 1's clock:
+        # staleness is pinned to when the fleet first fell behind, not
+        # to the store's last version edge
+        plane.param_store.publish({"w": np.full(2, 2.0)})   # version 3
+        w0.publish(dict(env_steps=25, param_version=3, incarnation=0))
+        prev = res["stale_params_s"][1]
+        res = plane.resilience_health()
+        assert res["stale_params_s"][1] >= prev
+        assert res["degraded"]
+        # the per-fleet gauge landed in the registry
+        assert plane.registry.get_gauge("fleet.stale_params_s",
+                                        fleet="1") > 0
+        # catching up clears it
+        w1.publish(dict(env_steps=30, param_version=2, incarnation=0))
+        res = plane.resilience_health()
+        assert res["stale_params_s"] == [0.0, 0.0]
+        assert not res["degraded"]
+    finally:
+        w0.close()
+        w1.close()
+        plane.stats_slab.close()
+
+
+def test_circuit_state_from_slab_degrades_health():
+    """A serve fleet publishing an open circuit through the stats slab
+    must flip the plane's resilience verdict to degraded and surface the
+    merged resilience counters."""
+    from r2d2_tpu.telemetry.slab import StatsSlabWriter
+
+    cfg = _serve_cfg()
+    plane = ProcessFleetPlane(cfg, A, make_fake_env, [0.4, 0.3])
+    plane.param_store = ParamStore({"w": np.zeros(2)})
+    w0 = StatsSlabWriter(plane.stats_slab.writer_info(0))
+    try:
+        w0.publish(dict(env_steps=5, param_version=1, incarnation=0,
+                        act_retries=4, circuit_opens=2, local_acts=123,
+                        circuit_state=1.0))
+        res = plane.resilience_health()
+        assert res["circuit_states"][0] == OPEN
+        assert res["circuits_open"] == 1
+        assert res["retries"] == 4
+        assert res["circuit_opens"] == 2
+        assert res["local_acts"] == 123
+        assert res["degraded"]
+        h = plane.health()
+        assert h["resilience"]["degraded"]
+    finally:
+        w0.close()
+        plane.stats_slab.close()
+
+
+# --------------------------------------------------- chaos e2e (serve)
+
+@pytest.mark.timeout(600)
+@pytest.mark.chaos
+def test_train_serve_freeze_service_degrades_and_reattaches():
+    """ISSUE 7 acceptance e2e: with freeze_service armed, a serve-mode
+    train() run survives with ZERO fleet deaths — the fleets open their
+    circuits and keep producing blocks through degraded local inference
+    (updates keep flowing), then re-attach after the thaw (circuits
+    closed, hidden resynced through probe requests).  The run is stopped
+    by SIGTERM once the full freeze→degrade→re-attach cycle has been
+    observed in the health stream."""
+    import os
+    import signal
+
+    from r2d2_tpu.train import train
+
+    cfg = make_test_config(
+        game_name="Fake", num_actors=2, actor_fleets=2,
+        actor_transport="process", actor_inference="serve",
+        training_steps=10 ** 9, log_interval=0.2,
+        act_response_timeout=0.5,
+        # the site counts one opportunity per SERVED batch, so at=50
+        # lands the freeze under real lockstep traffic (past the replay
+        # warm-up); dur outlasts the retries+probe window by enough that
+        # the 0.2s health stream samples the degraded window even when a
+        # loaded CI host starves the log loop for seconds
+        chaos_spec="freeze_service:at=50,dur=10")
+    seen = dict(degraded_entries=0, degraded_first_steps=None,
+                degraded_last_steps=0, cycle_done=False)
+
+    def sink(entry):
+        fleet = entry.get("fleet") or {}
+        res = fleet.get("resilience") or {}
+        if res.get("circuits_open", 0) > 0:
+            seen["degraded_entries"] += 1
+            if seen["degraded_first_steps"] is None:
+                seen["degraded_first_steps"] = entry["training_steps"]
+            seen["degraded_last_steps"] = entry["training_steps"]
+        if (not seen["cycle_done"]
+                and res.get("circuit_opens", 0) >= 1
+                and res.get("circuits_open", 1) == 0
+                and entry["training_steps"] > 0):
+            # full cycle observed: opened at least once, all re-attached,
+            # learner trained — drain-then-save stop
+            seen["cycle_done"] = True
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    m = train(cfg, env_factory=make_fake_env, max_wall_seconds=420,
+              verbose=False, log_sink=sink)
+    assert seen["cycle_done"], (
+        f"freeze→degrade→re-attach cycle never completed: {seen}, "
+        f"chaos={m.get('chaos')}")
+    assert m["num_updates"] > 0
+    assert np.isfinite(m["mean_loss"])
+    assert not m["fabric_failed"]
+    assert m["chaos"]["freeze_service"] == 1, "the freeze never fired"
+
+    fleet = m["fleet_health"]
+    # ZERO fleet deaths: no respawns, no failures — the old behavior was
+    # N RuntimeErrors and a burned respawn budget
+    assert fleet["restarts"] == [0, 0]
+    assert not fleet["failed"]
+    res = fleet["resilience"]
+    assert res["circuit_opens"] >= 1, "no circuit ever opened"
+    assert res["local_acts"] > 0, "no degraded-mode acting happened"
+    assert res["retries"] >= 1
+    # re-attached: every circuit closed again
+    assert res["circuits_open"] == 0
+    # the re-attach probes resynced server hidden from the fleet carries
+    assert fleet["service"]["resyncs"] >= 1
+    # the degraded window was observable in the health stream, and the
+    # learner kept updating through it (updates/s > 0 while degraded)
+    assert seen["degraded_entries"] >= 1
+    assert fleet["blocks_ingested"] > 0
+    assert all(c > 0 for c in fleet["blocks_per_fleet"])
+
+
+# ----------------------------------------------- anakin wedge_dispatch
+
+@pytest.mark.timeout(600)
+@pytest.mark.chaos
+@pytest.mark.parametrize("wedge_dur", [1.2, 0.45],
+                         ids=["hard", "slow"])
+def test_anakin_wedge_dispatch_snapshots_and_aborts(tmp_path, wedge_dur):
+    """The deferred anakin chaos site: a wedged dispatch (harvest stalled
+    past cfg.dispatch_deadline) must produce a RESUMABLE snapshot and a
+    clean abort — not a hang (this test runs under the suite's pytest
+    timeout) and not an endless crawl on a flaky device.  --resume then
+    continues from the parked state.
+
+    Both wedge grades are drilled: ``dur=1.2`` outlasts the 2x-budget
+    grace (hard wedge — fetch abandoned, bounded snapshot), ``dur=0.45``
+    lands inside it (slow wedge — the fetch completes over budget, the
+    pipeline drains and the snapshot is written inline)."""
+    from r2d2_tpu.checkpoint import Checkpointer
+    from r2d2_tpu.train import train
+
+    ck = str(tmp_path / "ck")
+    cfg = make_test_config(
+        game_name="Fake", actor_transport="anakin",
+        device_replay=True, in_graph_per=True,
+        num_actors=2, superstep_k=2, anakin_episode_len=12,
+        training_steps=1000, learning_starts=16, log_interval=0.2,
+        dispatch_deadline=0.3,
+        chaos_spec=f"wedge_dispatch:at=3,dur={wedge_dur}")
+    m = train(cfg, checkpoint_dir=ck, verbose=False,
+              max_wall_seconds=240)
+    assert m["dispatch_wedged"] is True, "the deadline never tripped"
+    assert m["chaos"]["wedge_dispatch"] == 1
+    assert 0 < m["num_updates"] < cfg.training_steps  # aborted early
+    assert not m["fabric_failed"]                     # CLEAN abort
+    # the resumable artifact: a full anakin loop snapshot was parked
+    assert Checkpointer(ck).replay_steps(), "no snapshot at the wedge"
+
+    # and --resume genuinely continues from it (no wedge this time)
+    m2 = train(cfg.replace(chaos_spec="",
+                           training_steps=m["num_updates"] + 4),
+               checkpoint_dir=ck, resume=True, verbose=False,
+               max_wall_seconds=240)
+    assert m2["restored_replay"], "resume came up cold"
+    assert m2["dispatch_wedged"] is False
+    assert m2["num_updates"] >= m["num_updates"] + 4
+
+
+# ------------------------------------------------- three-state healthz
+
+def test_healthz_three_state_contract():
+    """ok → HTTP 200 status "ok"; degraded → HTTP 200 status "degraded"
+    (a degraded instance still serves — evicting it would defeat
+    graceful degradation); failing → HTTP 503.  r2d2_top renders the
+    degraded verdict."""
+    import json
+    import os
+    import urllib.error
+    import urllib.request
+
+    from r2d2_tpu.telemetry import MetricsRegistry, TelemetryExporter
+
+    health = dict(ok=True, degraded=False, status="ok")
+    ex = TelemetryExporter(MetricsRegistry(), lambda: dict(health), port=0)
+
+    def loop():
+        while not ex.closed:
+            try:
+                ex.handle_once()
+            except (OSError, ValueError):
+                return
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{ex.port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "ok"
+        health.update(degraded=True, status="degraded")
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            assert resp.status == 200                 # still serving
+            body = json.loads(resp.read())
+            assert body["status"] == "degraded" and body["degraded"]
+        health.update(ok=False, degraded=False, status="failing")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "failing"
+    finally:
+        ex.close()
+
+    # r2d2_top renders the degraded state distinctly
+    import importlib.util
+
+    top_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "r2d2_top.py")
+    spec = importlib.util.spec_from_file_location("r2d2_top_res", top_path)
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    entry = dict(training_steps=1, updates_per_sec=1.0, buffer_size=1,
+                 env_steps=1, mean_episode_return=0.0, mean_loss=0.0,
+                 fleet=dict(alive=2, fleets=2, restarts=[0, 0],
+                            blocks_ingested=1, blocks_corrupt=0,
+                            resilience=dict(circuits_open=1,
+                                            circuit_opens=2, retries=3,
+                                            local_acts=9,
+                                            max_stale_params_s=0.0)))
+    frame = top.render(entry, health=dict(ok=True, status="degraded",
+                                          threads={}))
+    assert "** DEGRADED **" in frame
+    assert "circuits_open=1" in frame
+    frame_ok = top.render(entry, health=dict(ok=True, status="ok",
+                                             threads={}))
+    assert "DEGRADED" not in frame_ok.splitlines()[1]

@@ -40,9 +40,10 @@ def test_repo_tree_is_clean():
     report = run_analysis([os.path.join(REPO_ROOT, "r2d2_tpu"),
                            os.path.join(REPO_ROOT, "tools")],
                           root=REPO_ROOT)
-    assert len(report.rules) >= 5
+    assert len(report.rules) >= 6
     assert {"jit-purity", "config-integrity", "thread-discipline",
-            "wire-format", "telemetry-discipline"} <= set(report.rules)
+            "wire-format", "telemetry-discipline",
+            "bounded-wait"} <= set(report.rules)
     assert report.errors == []
     assert report.findings == [], "\n".join(
         f.format() for f in report.findings)
@@ -50,6 +51,9 @@ def test_repo_tree_is_clean():
     suppressed_at = {(f.path, f.rule) for f in report.suppressed}
     assert suppressed_at <= {
         ("r2d2_tpu/bench.py", "thread-discipline"),
+        # bounded-join fetch/snapshot helpers of the dispatch deadline:
+        # abandoned on a hard wedge by design, nothing to supervise
+        ("r2d2_tpu/learner/anakin.py", "thread-discipline"),
         ("r2d2_tpu/parallel/actor_procs.py", "thread-discipline"),
         # nullable-tracer pass-through helper; call sites pass literals
         ("r2d2_tpu/parallel/inference_service.py", "telemetry-discipline"),
@@ -332,6 +336,65 @@ def test_thread_discipline_suppressed_with_reason():
         t = threading.Thread(target=f)  # graftlint: disable=thread-discipline -- bounded, joined below
         t.start(); t.join()
     """), rules=["thread-discipline"])
+    assert report.findings == [] and len(report.suppressed) == 1
+
+
+# ------------------------------------------------------ bounded-wait rules
+
+def test_bounded_wait_flags_unbounded_blocks_in_loops_and_targets():
+    """Unbounded get/wait/join inside a *_loop function, a Thread
+    target, or a Supervisor-started function are findings — every
+    supervised wait must carry a timeout (ISSUE 7)."""
+    report = analyze_source(_src("""
+        import threading
+
+        def ingest_loop(q, ev):
+            item = q.get()
+            ev.wait()
+
+        def drain(q, t):
+            q.get()
+            t.join()
+
+        def pumper(q):
+            q.get()
+
+        threading.Thread(target=drain)  # graftlint: disable=thread-discipline -- fixture
+        sup.start("pump", pumper)
+    """), rules=["bounded-wait"])
+    msgs = [f.message for f in report.findings]
+    assert len(report.findings) == 5
+    assert any(".get()" in m and "ingest_loop" in m for m in msgs)
+    assert any(".wait()" in m for m in msgs)
+    assert any(".join()" in m and "drain" in m for m in msgs)
+    assert any("pumper" in m for m in msgs)
+
+
+def test_bounded_wait_negative_timeouts_and_out_of_scope():
+    """Timeout-carrying waits pass; dict-style .get(key) passes; waits
+    outside loop/thread-target scope are out of this rule's business."""
+    report = analyze_source(_src("""
+        def sample_loop(q, ev, t, d):
+            a = q.get(timeout=0.2)
+            ev.wait(0.5)
+            t.join(5.0)
+            b = d.get("key")        # an argument: not an unbounded block
+            return a, b
+
+        def plain_helper(q):
+            return q.get()          # not a loop / target: out of scope
+    """), rules=["bounded-wait"])
+    assert report.findings == []
+
+
+def test_bounded_wait_suppressed_with_reason():
+    report = analyze_source(_src("""
+        def drain_loop(q):
+            while True:
+                item = q.get()  # graftlint: disable=bounded-wait -- producer guarantees a sentinel on every exit path
+                if item is None:
+                    return
+    """), rules=["bounded-wait"])
     assert report.findings == [] and len(report.suppressed) == 1
 
 

@@ -271,6 +271,87 @@ def test_runlog_torn_final_line_and_tail(tmp_path):
 
 # ------------------------------------------------------------- exporter
 
+def test_supervisor_giveup_flips_healthz_and_stamps_registry():
+    """ISSUE 7 satellite: the supervisor give-up path.  A thread that
+    exhausts its restart budget must (a) flip the /healthz verdict to
+    503 via ``Supervisor.any_failed`` and (b) stamp ``supervisor.gaveup``
+    into the registry through the supervisor's own on_giveup hook — the
+    exact wiring train() installs (the log loop, the usual absorption
+    path, may be the very thread that died)."""
+    import urllib.error
+    import urllib.request
+
+    from r2d2_tpu.utils.supervisor import Supervisor
+
+    reg = MetricsRegistry()
+    sup = Supervisor(max_restarts=0, backoff=0.01,
+                     on_giveup=lambda name: reg.inc("supervisor.gaveup",
+                                                    thread=name))
+
+    def doomed_loop():
+        raise RuntimeError("plane down")
+
+    sup.start("doomed", doomed_loop)
+    deadline = time.time() + 30
+    while not sup.any_failed:
+        assert time.time() < deadline, "supervisor never gave up"
+        time.sleep(0.02)
+
+    # the same three-state healthz shape train() serves
+    def healthz():
+        ok = not sup.any_failed
+        return dict(ok=ok, degraded=False,
+                    status="ok" if ok else "failing",
+                    threads=sup.health())
+
+    ex = TelemetryExporter(reg, healthz, port=0)
+    _serve(ex)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ex.port}/healthz")
+        assert e.value.code == 503
+        body = json.loads(e.value.read())
+        assert body["status"] == "failing"
+        assert body["threads"]["doomed"]["gave_up"]
+        # the registry stamp (scrapeable on /metrics)
+        assert reg.get_counter("supervisor.gaveup", thread="doomed") == 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ex.port}/metrics") as resp:
+            assert "r2d2_supervisor_gaveup_total" in resp.read().decode()
+    finally:
+        ex.close()
+    # record() absorption is the belt: a health entry with a gave-up
+    # thread stamps the counter even without the callback wiring
+    t = Telemetry(make_test_config())
+    t.record(dict(training_steps=1, env_steps=1,
+                  health={"pump": dict(alive=False, restarts=3,
+                                       gave_up=True)}))
+    assert t.registry.get_counter("supervisor.gaveup", thread="pump") == 1
+
+
+@pytest.mark.timeout(600)
+def test_train_e2e_supervisor_giveup_stops_fabric():
+    """A fabric thread dying past its restart budget must end the run
+    with fabric_failed and the give-up visible in the returned health —
+    not hang the trainer (the reference would simply starve forever)."""
+    cfg = make_test_config(game_name="Fake", training_steps=100000,
+                           log_interval=0.1)
+    calls = []
+
+    def poisoned_sink(entry):
+        calls.append(entry)
+        raise RuntimeError("log plane poisoned")
+
+    m = train(cfg, env_factory=env_factory, verbose=False,
+              log_sink=poisoned_sink, max_thread_restarts=0,
+              max_wall_seconds=180)
+    assert calls, "the log loop never ran"
+    assert m["fabric_failed"] is True
+    assert m["health"]["log"]["gave_up"] is True
+    assert m["num_updates"] < 100000      # the give-up stopped the run
+
+
 def test_exporter_disabled_at_port_zero():
     cfg = make_test_config()                 # telemetry_port defaults 0
     assert cfg.telemetry_port == 0
@@ -370,9 +451,13 @@ def test_train_e2e_metrics_endpoint_aggregates_fleet_counters(tmp_path):
 
     def sink(entry):
         seen["port"] = entry["telemetry_port"]
-        totals = (entry.get("fleet") or {}).get("stats", {}).get(
-            "totals", {})
-        if seen["scraped"] is None and totals.get("env_steps", 0) > 0:
+        stats = (entry.get("fleet") or {}).get("stats", {})
+        # wait until EVERY fleet has published through the slab at least
+        # once — scraping on the first fleet's publish races the second
+        # fleet's spawn and finds only one labeled series
+        rows = stats.get("per_fleet", [])
+        if seen["scraped"] is None and len(rows) == 2 and all(
+                r.get("env_steps", 0) > 0 for r in rows):
             base = f"http://127.0.0.1:{seen['port']}"
             with urllib.request.urlopen(base + "/metrics",
                                         timeout=10) as resp:

@@ -11,12 +11,20 @@ replay mass/size match the snapshot meta, the learner state restores,
 and training keeps advancing.  Exit code 1 on any violated invariant.
 
 Run:  python tools/chaos_soak.py [minutes] [--process] [--serve]
-                                 [--out OUT.json]
+                                 [--anakin] [--out OUT.json]
 
 ``--process`` soaks the subprocess actor plane (enables the kill_fleet /
 garble_block sites); ``--serve`` additionally routes acting through the
 centralized InferenceService (implies --process — the kill_fleet site
-then also drills the respawn path's server-hidden zeroing).  Default
+then also drills the respawn path's server-hidden zeroing, and the
+degraded-mode sites are armed: ``freeze_service`` forces a full
+freeze→circuit-open→local-fallback→re-attach cycle every round, with
+``drop_act_response`` / ``garble_act_response`` / ``stall_pump`` noise
+on top; a round fails if any fleet's circuit is still open at exit or
+if the freeze produced fleet deaths).  ``--anakin`` soaks the fused
+on-device loop with ``wedge_dispatch`` armed against a tight
+``dispatch_deadline``: wedged rounds must abort cleanly with a
+resumable snapshot, and the next round must come up warm.  Default
 soaks the thread transport (freeze + truncate sites only).
 """
 import json
@@ -29,6 +37,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _argv = sys.argv[1:]
 SERVE = "--serve" in _argv
+ANAKIN = "--anakin" in _argv
 PROCESS = "--process" in _argv or SERVE
 OUT = None
 if "--out" in _argv:
@@ -67,16 +76,36 @@ def main() -> int:
     preflight(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     chaos = "freeze_learner:every=40,dur=0.5;truncate_ckpt:p=0.3"
     transport = dict(actor_transport="thread")
-    if PROCESS:
+    extra = {}
+    if ANAKIN:
+        # fused-loop mode: the wedge_dispatch site vs a tight dispatch
+        # deadline — every wedge must abort cleanly with a snapshot
+        chaos = "wedge_dispatch:every=60,dur=1.0,n=1000000"
+        transport = dict(actor_transport="anakin", num_actors=2,
+                         superstep_k=2, anakin_episode_len=12,
+                         learning_starts=16)
+        extra = dict(dispatch_deadline=0.4)
+    elif PROCESS:
         chaos += ";kill_fleet:every=120;garble_block:p=0.005"
         transport = dict(actor_transport="process", num_actors=2,
                          actor_fleets=2,
                          actor_inference="serve" if SERVE else "local")
+        # the param-staleness watchdog drill rides along either way
+        chaos += ";stall_pump:every=300,dur=2,n=1000000"
+        if SERVE:
+            # one full freeze→degrade→re-attach cycle per round, plus
+            # response loss/corruption noise absorbed by bounded retry
+            # freeze opportunities count SERVED batches, so every=800
+            # forces a full degrade→re-attach cycle well inside a round
+            chaos += (";freeze_service:every=800,dur=4,n=1000000"
+                      ";drop_act_response:p=0.002"
+                      ";garble_act_response:p=0.002")
+            extra = dict(act_response_timeout=0.5)
     cfg = test_config(
         game_name="Fake", training_steps=10 ** 9, log_interval=1.0,
         save_interval=200, keep_checkpoints=3, chaos_spec=chaos,
         learner_stall_timeout=30.0, replay_snapshot_interval=5.0,
-        seed=int(time.time()) & 0xFFFF, **transport)
+        seed=int(time.time()) & 0xFFFF, **transport, **extra)
 
     deadline = time.time() + MINUTES * 60
     rounds, failures = [], []
@@ -91,20 +120,35 @@ def main() -> int:
             rnd = 0
             while time.time() < deadline:
                 rnd += 1
-                m = train(cfg, env_factory=env_factory,
-                          checkpoint_dir=ck_dir, resume=rnd > 1,
+                kwargs = {} if ANAKIN else dict(env_factory=env_factory)
+                rcfg = cfg
+                if ANAKIN:
+                    # alternate the wedge grade: odd rounds stall past
+                    # the 2x-budget grace (hard wedge — fetch abandoned,
+                    # bounded snapshot), even rounds land inside it
+                    # (slow wedge — drain + inline snapshot) so BOTH
+                    # abort paths stay drilled
+                    dur = 1.0 if rnd % 2 else 0.6
+                    rcfg = cfg.replace(
+                        chaos_spec="wedge_dispatch:every=60,"
+                                   f"dur={dur},n=1000000")
+                m = train(rcfg, checkpoint_dir=ck_dir, resume=rnd > 1,
                           verbose=False,
                           log_sink=lambda e, r=rnd: runlog.append(
                               dict(e, round=r)),
                           max_wall_seconds=min(45.0,
-                                               deadline - time.time()))
+                                               deadline - time.time()),
+                          **kwargs)
                 ck = Checkpointer(ck_dir)
+                fleet = m.get("fleet_health") or {}
                 rec = dict(round=rnd, updates=m["num_updates"],
                            buffer=m["buffer_size"],
                            restored=m.get("restored_replay"),
                            stalled=m.get("learner_stalled"),
+                           wedged=m.get("dispatch_wedged"),
                            chaos=m.get("chaos"),
-                           fleet=(m.get("fleet_health") or {}),
+                           fleet=fleet,
+                           resilience=fleet.get("resilience"),
                            complete_steps=ck.steps(),
                            partial_steps=[s for s in
                                           ck.steps(complete=False)
@@ -119,10 +163,19 @@ def main() -> int:
                 # the point.)
                 if rnd > 1 and not m.get("restored_replay"):
                     failures.append(f"round {rnd}: resume came up cold")
+                if ANAKIN and m.get("dispatch_wedged") \
+                        and not ck.replay_steps():
+                    failures.append(
+                        f"round {rnd}: wedged abort left no resumable "
+                        "snapshot")
                 rep = ck.restore_replay()
                 if rep is not None:
                     meta = rep[0]
-                    if meta["counters"]["size"] < 0:
+                    # anakin snapshots carry kind="anakin" and their own
+                    # payload layout — the ring-counter check is
+                    # host-ring-shaped only
+                    counters = meta.get("counters") or {}
+                    if counters.get("size", 0) < 0:
                         failures.append(
                             f"round {rnd}: negative snapshot size")
                 if len(ck.steps()) > cfg.keep_checkpoints:
@@ -132,6 +185,24 @@ def main() -> int:
     finally:
         runlog.close()
 
+    # soak-level failover invariant (--serve): if any freeze_service
+    # fired, at least one circuit must have opened AND at least one
+    # re-attach resync must have landed somewhere in the soak — a freeze
+    # the fleets never noticed, or a degrade that never re-attached,
+    # both mean the failover path is broken.  (Per-round end-state is
+    # not checked: a 45 s round may legitimately END mid-freeze.)
+    if SERVE and rounds:
+        freezes = sum((r["chaos"] or {}).get("freeze_service", 0)
+                      for r in rounds)
+        opens = sum((r.get("resilience") or {}).get("circuit_opens", 0)
+                    for r in rounds)
+        resyncs = sum(((r["fleet"].get("service") or {}).get("resyncs", 0))
+                      for r in rounds)
+        if freezes and not opens:
+            failures.append("freeze_service fired but no circuit opened")
+        if opens and not resyncs:
+            failures.append("circuits opened but no re-attach resync "
+                            "ever landed")
     summary = dict(minutes=MINUTES, rounds=len(rounds), failures=failures,
                    final_updates=last_updates,
                    telemetry_jsonl=runlog.path,

@@ -46,10 +46,17 @@ def render(entry, health=None) -> str:
     threads = health.get("threads") or {}
     dead = [n for n, h in threads.items() if not h.get("alive")]
     restarts = sum(h.get("restarts", 0) for h in threads.values())
+    # three-state verdict (docs/OBSERVABILITY.md): ok / degraded / failing
+    if not health.get("ok", True):
+        verdict = "  ** NOT OK **"
+    elif health.get("status") == "degraded" or health.get("degraded"):
+        verdict = "  ** DEGRADED **"
+    else:
+        verdict = ""
     lines.append(f"  fabric: {len(threads)} threads"
                  + (f", DEAD: {','.join(sorted(dead))}" if dead else "")
                  + (f", restarts={restarts}" if restarts else "")
-                 + ("" if health.get("ok", True) else "  ** NOT OK **"))
+                 + verdict)
     fleet = entry.get("fleet")
     if fleet:
         stats = (fleet.get("stats") or {}).get("totals") or {}
@@ -59,6 +66,16 @@ def render(entry, health=None) -> str:
             f"blocks={fleet.get('blocks_ingested', 0)} "
             f"corrupt={fleet.get('blocks_corrupt', 0)} "
             f"actor_env_steps={int(stats.get('env_steps', 0))}")
+        res = fleet.get("resilience") or {}
+        if (res.get("circuits_open") or res.get("circuit_opens")
+                or res.get("max_stale_params_s", 0) > 1.0):
+            lines.append(
+                "  resilience: "
+                f"circuits_open={res.get('circuits_open', 0)} "
+                f"opens={int(res.get('circuit_opens', 0))} "
+                f"retries={int(res.get('retries', 0))} "
+                f"local_acts={int(res.get('local_acts', 0))} "
+                f"stale_params_s={res.get('max_stale_params_s', 0.0)}")
     chaos = entry.get("chaos")
     if chaos:
         lines.append("  chaos: " + " ".join(f"{k}={v}"
