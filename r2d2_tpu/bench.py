@@ -71,15 +71,19 @@ def _learner_micro_bench(steps: int, warmup: int, fused: bool = False):
     import jax
 
     from r2d2_tpu.config import Config
-    from r2d2_tpu.learner.step import create_train_state, jit_train_step
+    from r2d2_tpu.learner.step import create_train_state
     from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.parallel.sharding import pjit_train_step
 
     cfg = Config(fused_double_unroll=fused)
     action_dim = 9  # MsPacman minimal action set
     net = create_network(cfg, action_dim)
     params = init_params(cfg, net, jax.random.PRNGKey(0))
     state = create_train_state(cfg, params)
-    step_fn = jit_train_step(cfg, net)
+    # donate_batch=False: this timing loop deliberately re-steps ONE
+    # device-resident batch; the training drivetrains always donate
+    step_fn = pjit_train_step(cfg, net, state_template=state,
+                              donate_batch=False)
 
     rng = np.random.default_rng(0)
     batch = {k: jax.device_put(v) for k, v in make_batch(cfg, action_dim,

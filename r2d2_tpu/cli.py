@@ -153,7 +153,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "utils/resilience.py); overrides "
                          "cfg.act_response_timeout (must be > 0)")
     pt.add_argument("--mesh", action="store_true",
-                    help="data-parallel learner over all visible devices")
+                    help="GSPMD learner over all visible devices: one "
+                         "table-driven pjit train step on the dp x fsdp x "
+                         "tp mesh (cfg.mesh_shape; default puts every "
+                         "device on dp)")
+    pt.add_argument("--sharding-table", default=None, metavar="SPEC",
+                    help="override/extend the per-param sharding table "
+                         "(parallel/sharding.py), e.g. "
+                         "'lstm_*.wh=,tp;head.*.kernel=' — pattern="
+                         "axis,axis clauses over the dp/fsdp/tp mesh "
+                         "axes; overrides cfg.sharding_table "
+                         "(docs/SHARDING.md)")
     pt.add_argument("--distributed", action="store_true",
                     help="join the multi-host JAX runtime first "
                          "(jax.distributed via JAX_COORDINATOR_ADDRESS / "
@@ -219,6 +229,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.act_response_timeout is not None:
                 cfg = cfg.replace(
                     act_response_timeout=args.act_response_timeout)
+            if args.sharding_table is not None:
+                cfg = cfg.replace(sharding_table=args.sharding_table)
         except ValueError as e:
             parser.error(str(e))
         if args.sync and args.max_wall_seconds is not None:

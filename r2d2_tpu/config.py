@@ -9,7 +9,89 @@ a ``Config`` explicitly.
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+import re
+from typing import Dict, Optional, Tuple
+
+# the canonical learner-mesh axes, in mesh order (parallel/mesh.py's AXES
+# aliases this — defined here so Config validation needs no jax import);
+# the r8-era "mp" axis folded into "tp" with the sharding table
+MESH_AXES = ("dp", "fsdp", "tp")
+
+
+def validate_mesh_shape(mesh_shape) -> dict:
+    """The single mesh-axis rule set (axis names, duplicates, sizes),
+    shared by Config.__post_init__ and parallel/mesh.make_mesh so the
+    two can never drift.  Returns {axis: size or None} for the named
+    axes."""
+    sizes = {name: None for name in MESH_AXES}
+    for name, size in mesh_shape:
+        if name not in MESH_AXES:
+            raise ValueError(
+                f"unknown mesh axis {name!r} in mesh_shape (expected one "
+                f"of {MESH_AXES}; the 'mp' axis was folded into 'tp' "
+                "with the sharding table)")
+        if sizes[name] is not None:
+            raise ValueError(f"duplicate mesh axis {name!r}")
+        if int(size) < 1:
+            raise ValueError(f"mesh axis {name!r} size must be >= 1")
+        sizes[name] = int(size)
+    return sizes
+
+
+_INT_TOKEN = re.compile(r"^\d+$")
+_INT_SUFFIX = re.compile(r"^(.+?)_\d+$")
+
+
+def normalize_token(token: str) -> str:
+    """Wildcard integer layer indices: ``"3"`` → ``"*"``, ``"lstm_0"`` →
+    ``"lstm_*"`` (all layers of a family share one layout — SNIPPETS.md
+    [3]'s ``_process_sharding_name``)."""
+    if _INT_TOKEN.match(token):
+        return "*"
+    m = _INT_SUFFIX.match(token)
+    if m:
+        return m.group(1) + "_*"
+    return token
+
+
+def parse_table(spec: str) -> Dict[str, Tuple[Optional[str], ...]]:
+    """Parse a ``cfg.sharding_table`` override string.
+
+    Format: ``pattern=axis,axis;pattern2=...`` — one entry per pattern,
+    dims comma-separated, an empty slot (or no slots at all) replicates.
+    E.g. ``"lstm_*.wh=,tp;head.*.kernel="`` keeps ``wh``'s input dim
+    replicated but tp-splits its gates, and fully replicates the head
+    kernels.  Raises ``ValueError`` on malformed entries or unknown axis
+    names (validated at Config construction, not mid-run).
+
+    Lives here (not parallel/sharding.py, which re-exports it) so Config
+    validation stays jax-free — the grammar only needs ``MESH_AXES``.
+    """
+    out: Dict[str, Tuple[Optional[str], ...]] = {}
+    for clause in filter(None, (c.strip() for c in spec.split(";"))):
+        if "=" not in clause:
+            raise ValueError(
+                f"sharding_table clause {clause!r} is not 'pattern=axes'")
+        pattern, axes = clause.split("=", 1)
+        pattern = pattern.strip()
+        if not pattern:
+            raise ValueError("sharding_table clause with empty pattern")
+        # normalize concrete layer indices to the table's wildcard form
+        # ("lstm_0.wh" → "lstm_*.wh"): lookup() normalizes the LEAF path
+        # before matching, so a verbatim "lstm_0" entry could never match
+        # and the override would be a silent no-op
+        pattern = ".".join(normalize_token(t) for t in pattern.split("."))
+        dims = []
+        for d in axes.split(","):
+            d = d.strip()
+            if d and d not in MESH_AXES:
+                raise ValueError(
+                    f"sharding_table axis {d!r} not in {MESH_AXES}")
+            dims.append(d or None)
+        if dims == [None]:
+            dims = []  # "pattern=" → fully replicated
+        out[pattern] = tuple(dims)
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,7 +176,31 @@ class Config:
                                       # kernel keeps its 1.07x inference
                                       # edge, ops/lstm.py)
     pallas_interpret: bool = False    # run pallas kernels interpreted (CPU tests)
-    mesh_shape: Tuple[Tuple[str, int], ...] = ()  # e.g. (("dp", 4), ("mp", 2))
+    mesh_shape: Tuple[Tuple[str, int], ...] = ()  # learner mesh axes, e.g.
+                                      # (("dp", 4), ("fsdp", 2), ("tp", 2)):
+                                      # dp = data parallel (batch rows,
+                                      # ring slots, grad psums), fsdp =
+                                      # param/moment sharding for memory,
+                                      # tp = Megatron-style tensor split
+                                      # of the LSTM 4H / dense output
+                                      # dims.  Omitted axes default to 1;
+                                      # empty = all local devices on dp.
+                                      # Which param shards where is the
+                                      # sharding table's decision
+                                      # (parallel/sharding.py,
+                                      # docs/SHARDING.md)
+    sharding_table: str = ""          # per-param sharding-table override:
+                                      # "pattern=axis,axis;pattern2=..."
+                                      # entries extend/replace the default
+                                      # table (parallel/sharding.py
+                                      # DEFAULT_TABLE) — e.g.
+                                      # "lstm_*.wh=,tp;head.*.kernel="
+                                      # tp-splits wh's gates and fully
+                                      # replicates the head kernels.
+                                      # Patterns match trailing param-path
+                                      # tokens with integer layer indices
+                                      # wildcarded; "" keeps the default
+                                      # table (docs/SHARDING.md)
     prefetch_batches: int = 4         # reference staging list depth, worker.py:312
     env_workers: int = 0              # >1: thread-pool env stepping (the
                                       # reference's N-process parallelism,
@@ -395,9 +501,10 @@ class Config:
         if self.in_graph_per and not self.device_replay:
             raise ValueError("in_graph_per requires device_replay=True "
                              "(sampling reads the HBM-resident ring)")
-        # in_graph_per composes with every ring layout: replicated rings
-        # sample globally, dp-sharded rings sample per group slab inside
-        # shard_map (parallel/mesh.py sharded_in_graph_per_super_step)
+        # in_graph_per composes with every ring layout: the stratified
+        # draw is global either way — under a dp-sharded ring the PER
+        # leaves shard with the slabs and GSPMD inserts the collectives
+        # (parallel/sharding.py pjit_in_graph_per_super_step)
         if self.device_ring_layout not in ("auto", "replicated", "dp"):
             raise ValueError(
                 f"unknown device_ring_layout {self.device_ring_layout!r}")
@@ -431,6 +538,13 @@ class Config:
             from r2d2_tpu.utils.chaos import parse_spec
 
             parse_spec(self.chaos_spec)
+        # mesh axes are fixed (dp, fsdp, tp) — the sharding table resolves
+        # against them
+        validate_mesh_shape(self.mesh_shape)
+        if self.sharding_table:
+            # fail at construction, not mid-compile: parse_table raises on
+            # malformed clauses / unknown axis names
+            parse_table(self.sharding_table)
         if self.stored_hidden_mode not in ("burn_in_start", "seq_start"):
             raise ValueError(
                 f"unknown stored_hidden_mode {self.stored_hidden_mode!r}")

@@ -3,7 +3,6 @@ from r2d2_tpu.learner.step import (
     create_train_state,
     make_optimizer,
     make_train_step,
-    jit_train_step,
     loss_and_priorities,
     value_rescale,
     inverse_value_rescale,

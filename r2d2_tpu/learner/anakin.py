@@ -226,13 +226,22 @@ def _make_emit(cfg: Config, action_dim: int, done: bool):
 
 
 def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
-                     action_dim: int):
+                     action_dim: int, cut_cond: bool = True):
     """One fused env/actor step for the whole fleet — the jnp twin of one
     ``VectorActor.run`` iteration, same sub-step order (boundary cuts with
     this step's bootstrap Q first, then act/step/record, then episode-end
     cuts and lane resets).  Returns ``(carry', trace)``; the production
     scan discards ``trace`` (XLA dead-code-eliminates it), the parity
-    tests keep it to drive the host LocalBuffer oracle."""
+    tests keep it to drive the host LocalBuffer oracle.
+
+    ``cut_cond`` (default on) wraps each emit/retention block in a
+    ``lax.cond`` on ``jnp.any(cut)``: on the (block_length-1)/block_length
+    majority of steps where NO lane cuts, the full-buffer block assembly,
+    retention gathers, and ring scatters are skipped entirely instead of
+    executing as all-masked no-ops.  Bit-exact by construction — a no-cut
+    emit writes only to the dropped sentinel slot and a no-cut retention
+    is the identity — and pinned vs the ``cut_cond=False`` path in
+    tests/test_anakin.py."""
     N, A, BL = cfg.num_actors, action_dim, cfg.block_length
     cap = cfg.max_block_steps
     eps = jnp.asarray([epsilon_ladder(i, cfg.num_actors, cfg.base_eps,
@@ -251,9 +260,19 @@ def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
         # 1) deferred block-boundary cuts: this step's Q at the new state
         #    is the bootstrap (worker.py:550-554 semantics, no 2nd forward)
         pend = ast["finish_pending"]
-        ast, arrays, prios, seq_meta, first = emit_boundary(
-            ast, arrays, prios, seq_meta, first, pend, q)
-        ast = _retain_prefix(cfg, ast, pend)
+
+        def _boundary(ops):
+            a, arr, p, sm, fb = ops
+            a, arr, p, sm, fb = emit_boundary(a, arr, p, sm, fb, pend, q)
+            return _retain_prefix(cfg, a, pend), arr, p, sm, fb
+
+        if cut_cond:
+            ast, arrays, prios, seq_meta, first = jax.lax.cond(
+                jnp.any(pend), _boundary, lambda ops: ops,
+                (ast, arrays, prios, seq_meta, first))
+        else:
+            ast, arrays, prios, seq_meta, first = _boundary(
+                (ast, arrays, prios, seq_meta, first))
         ast = {**ast, "finish_pending": jnp.zeros(N, bool)}
 
         # 2) ladder-epsilon exploration
@@ -296,10 +315,18 @@ def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
                "env_phase": env_state["phase"], "env_t": env_state["t"],
                "env_key": env_state["key"]}
 
-        # 5) episode-end cuts (terminal: zero bootstrap)
-        ast, arrays, prios, seq_meta, first = emit_done(
-            ast, arrays, prios, seq_meta, first, truncated,
-            jnp.zeros((N, A), jnp.float32))
+        # 5) episode-end cuts (terminal: zero bootstrap); same cond fast
+        #    path — episode ends are rarer still than block boundaries
+        def _done_cut(ops):
+            return emit_done(*ops, truncated, jnp.zeros((N, A), jnp.float32))
+
+        if cut_cond:
+            ast, arrays, prios, seq_meta, first = jax.lax.cond(
+                jnp.any(truncated), _done_cut, lambda ops: ops,
+                (ast, arrays, prios, seq_meta, first))
+        else:
+            ast, arrays, prios, seq_meta, first = _done_cut(
+                (ast, arrays, prios, seq_meta, first))
 
         # 6) episode accounting, env reset, lane reset (VectorActor
         #    ._reset_lane: fresh obs, zero agent state, vbuf.reset_lane)
@@ -446,7 +473,8 @@ def make_anakin_state(cfg: Config, action_dim: int, env: AnakinFakeEnv,
 
 
 def make_anakin_super_step(cfg: Config, net: R2D2Network,
-                           env: AnakinFakeEnv, action_dim: int):
+                           env: AnakinFakeEnv, action_dim: int,
+                           cut_cond: bool = True):
     """The fused program: ``k × (E env/actor steps + 1 train step)`` in one
     dispatch.  Signature::
 
@@ -463,7 +491,8 @@ def make_anakin_super_step(cfg: Config, net: R2D2Network,
     """
     k, E = cfg.superstep_k, cfg.anakin_env_steps_per_update
     step = make_train_step(cfg, net)
-    actor_step = _make_actor_step(cfg, net, env, action_dim)
+    actor_step = _make_actor_step(cfg, net, env, action_dim,
+                                  cut_cond=cut_cond)
 
     def super_step(train_state: TrainState, ast, arrays, prios, seq_meta,
                    first, dispatch_idx):
@@ -524,12 +553,15 @@ def make_anakin_rollout(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
 
 
 def make_debug_rollout(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
-                       action_dim: int, steps: int):
+                       action_dim: int, steps: int, cut_cond: bool = True):
     """Parity-test harness: like :func:`make_anakin_rollout` but keeps the
     per-step trace (q, hidden, actions, rewards, cut masks, observations)
     so tests can replay the exact trajectory into the host LocalBuffer
-    oracle.  Not retrace-guarded or donated — test-only."""
-    actor_step = _make_actor_step(cfg, net, env, action_dim)
+    oracle.  ``cut_cond=False`` builds the pre-r9 always-emit variant for
+    the fast-path bit-exactness pin.  Not retrace-guarded or donated —
+    test-only."""
+    actor_step = _make_actor_step(cfg, net, env, action_dim,
+                                  cut_cond=cut_cond)
 
     def rollout(params, ast, arrays, prios, seq_meta, first):
         def env_it(c, _):

@@ -31,13 +31,13 @@ import numpy as np
 
 from r2d2_tpu.checkpoint import Checkpointer
 from r2d2_tpu.config import Config
-from r2d2_tpu.learner.step import TrainState, jit_train_step
+from r2d2_tpu.learner.step import TrainState
 from r2d2_tpu.models.network import R2D2Network
-from r2d2_tpu.parallel.mesh import (
+from r2d2_tpu.parallel.mesh import trivial_mesh
+from r2d2_tpu.parallel.sharding import (
     DEVICE_BATCH_KEYS,
-    batch_sharding,
-    replicate_state,
-    sharded_train_step,
+    ShardingTable,
+    pjit_train_step,
 )
 from r2d2_tpu.utils.store import ParamStore
 
@@ -64,10 +64,11 @@ class Learner:
                  mesh: Optional[Any] = None,
                  param_store: Optional[ParamStore] = None,
                  checkpointer: Optional[Checkpointer] = None,
-                 start_env_steps: int = 0, start_minutes: float = 0.0):
+                 start_env_steps: int = 0, start_minutes: float = 0.0,
+                 table: Optional[ShardingTable] = None):
         self.cfg = cfg
         self.net = net
-        self.mesh = mesh
+        self.mesh = mesh  # None = single-device (a trivial 1x1x1 mesh)
         self.param_store = param_store
         self.checkpointer = checkpointer
         self.env_steps = start_env_steps
@@ -76,15 +77,16 @@ class Learner:
         self._copy_params = None       # lazily-built one-dispatch snapshotter
         self._saved_steps: set = set()  # steps THIS run saved (see _save)
 
-        if mesh is not None:
-            self._step_fn = sharded_train_step(cfg, net, mesh,
-                                               state_template=state)
-            self._shardings = batch_sharding(mesh)
-            self.state = replicate_state(mesh, state)
-        else:
-            self._step_fn = jit_train_step(cfg, net)
-            self._shardings = None
-            self.state = state
+        # ONE train-step entry point for every topology: the table-driven
+        # pjit step (parallel/sharding.py).  A 1-device trivial mesh makes
+        # the single-device learner the degenerate case of the same code
+        # path — no separate jit variant, no mesh branches.
+        self.table = table if table is not None else ShardingTable(
+            mesh if mesh is not None else trivial_mesh(), cfg)
+        self._step_fn = pjit_train_step(cfg, net, self.table,
+                                        state_template=state)
+        self._shardings = self.table.batch_shardings()
+        self.state = self.table.place_state(state)
 
         if self.param_store is not None:
             self._publish()
@@ -139,18 +141,15 @@ class Learner:
         into one global sharded array (parallel/distributed.py) — batch
         data never crosses DCN."""
         host = {k: batch[k] for k in batch if k not in DEVICE_BATCH_KEYS}
-        if self._shardings is not None:
-            if jax.process_count() > 1:
-                from r2d2_tpu.parallel.distributed import host_local_batch
+        if jax.process_count() > 1 and self.mesh is not None:
+            from r2d2_tpu.parallel.distributed import host_local_batch
 
-                dev = host_local_batch(
-                    self.mesh, {k: batch[k] for k in DEVICE_BATCH_KEYS},
-                    shardings=self._shardings)
-            else:
-                dev = {k: jax.device_put(batch[k], self._shardings[k])
-                       for k in DEVICE_BATCH_KEYS}
+            dev = host_local_batch(
+                self.mesh, {k: batch[k] for k in DEVICE_BATCH_KEYS},
+                shardings=self._shardings)
         else:
-            dev = {k: jax.device_put(batch[k]) for k in DEVICE_BATCH_KEYS}
+            dev = {k: jax.device_put(batch[k], self._shardings[k])
+                   for k in DEVICE_BATCH_KEYS}
         return dev, host
 
     def run(self, batch_source: BatchSource,
@@ -368,9 +367,9 @@ class Learner:
         overshoot ``training_steps`` by up to k-1 updates.
 
         Under a mesh (single process): the ring is mesh-replicated (or
-        dp-sharded, ``ring.layout``) and the super-step is GSPMD-sharded
-        (parallel.mesh.sharded_super_step) — index bundles shard their
-        batch axis over dp, grads psum over ICI.
+        dp-sharded, ``ring.layout``) and the super-step is the table-driven
+        pjit program (parallel/sharding.pjit_super_step) — index bundles
+        shard their batch axis over dp, grads psum over ICI.
 
         Multi-host: dispatches to :meth:`_run_device_multihost` — each
         host owns the slot slabs of its dp groups (a dp-layout ring over
@@ -384,7 +383,7 @@ class Learner:
         if tracer is None:
             from r2d2_tpu.utils.trace import Tracer
             tracer = Tracer()
-        from r2d2_tpu.learner.step import make_super_step
+        from r2d2_tpu.parallel.sharding import pjit_super_step
 
         k = cfg.superstep_k
         t0 = time.time()
@@ -398,14 +397,9 @@ class Learner:
         # AOT-compile outside the buffer lock: the first dispatch happens
         # under it (sample_meta couples sampling + dispatch), and tracing a
         # fresh jit there would stall actor add()s for the whole compile
-        if self.mesh is not None:
-            from r2d2_tpu.parallel.mesh import sharded_super_step
-
-            super_fn = sharded_super_step(
-                cfg, self.net, self.mesh, k, state_template=self.state,
-                layout=getattr(ring, "layout", "replicated"))
-        else:
-            super_fn = make_super_step(cfg, self.net, k)
+        super_fn = pjit_super_step(
+            cfg, self.net, self.table, k, state_template=self.state,
+            layout=getattr(ring, "layout", "replicated"))
         B = cfg.batch_size
         # Lower from avals, not live ring handles: actor commits donate
         # the ring arrays (DeviceRing._write_slot), so a concurrent
@@ -528,10 +522,13 @@ class Learner:
         the ring's current handle and the returned one is stored back
         before the buffer lock is released, so actor block commits
         (``DeviceRing.commit_per``, same lock) always target the newest
-        generation.  Under a mesh: replicated rings keep the PER state
-        replicated with dp-constrained bundles; dp-sharded rings sample
-        per group slab inside shard_map — both via
-        parallel/mesh.py:sharded_in_graph_per_super_step.
+        generation.  Any mesh layout runs the SAME table-driven pjit step
+        (parallel/sharding.pjit_in_graph_per_super_step): the stratified
+        draw is global regardless of layout — under a dp-sharded ring the
+        PER leaves shard with the slabs and GSPMD inserts the collectives,
+        so over the same ring content a dp-sharded run draws the same
+        strata as a single-device one (pinned by
+        test_in_graph_per_dp_layout_matches_single_device).
 
         Multi-host (ring layout "dp" over each host's local submesh, as
         built by train.py): per dispatch the global ring + PER views are
@@ -546,28 +543,14 @@ class Learner:
         cfg = self.cfg
         multihost = jax.process_count() > 1
         layout = getattr(ring, "layout", "replicated")
-        if self.mesh is not None:
-            from r2d2_tpu.parallel.mesh import (
-                sharded_in_graph_per_super_step,
-            )
+        from r2d2_tpu.parallel.sharding import pjit_in_graph_per_super_step
 
-            super_fn = sharded_in_graph_per_super_step(
-                cfg, self.net, self.mesh, k, state_template=self.state,
-                layout=layout,
-                blocks_per_group=(ring.blocks_per_group
-                                  if layout == "dp" else None))
-        else:
-            from r2d2_tpu.learner.step import make_in_graph_per_super_step
-
-            super_fn = make_in_graph_per_super_step(cfg, self.net, k)
+        super_fn = pjit_in_graph_per_super_step(
+            cfg, self.net, self.table, k, state_template=self.state,
+            layout=layout)
 
         if multihost:
-            from r2d2_tpu.parallel.distributed import (
-                assemble_global, local_mesh,
-            )
-            from r2d2_tpu.replay.device_ring import (
-                per_sharding, ring_sharding,
-            )
+            from r2d2_tpu.parallel.distributed import assemble_global
 
             if layout != "dp":
                 raise RuntimeError(
@@ -577,9 +560,12 @@ class Learner:
             K = cfg.seqs_per_block
             bpg = ring.blocks_per_group
             GB = self.mesh.shape["dp"] * bpg       # global slot count
-            gsh_ring = ring_sharding(self.mesh, "dp")
-            gsh_per = per_sharding(self.mesh, "dp")
-            lsh_prios = per_sharding(local_mesh(self.mesh), "dp")["prios"]
+            gsh_ring = self.table.ring_shardings("dp")
+            gsh_per = self.table.per_shardings("dp")
+            # the ring's own table IS the local-submesh table train._build
+            # gave it — resolve the local prios layout through it rather
+            # than rebuilding one that could drift from the ring's
+            lsh_prios = ring.table.per_shardings("dp")["prios"]
             local_leaves = cfg.num_blocks * K
 
             def ring_args():
@@ -769,12 +755,13 @@ class Learner:
         4. harvests its dp rows of the priorities (local_rows axis=1) and
            feeds its own buffer — feedback never crosses hosts.
 
-        Batch bytes never touch host RAM, and never cross DCN: each
-        device gathers from its local slab inside shard_map; only grad
-        psums (ICI/DCN) and the tiny index/min-density collectives leave
-        the host.  Steps 2-3 run under the buffer lock (the device_ring
-        concurrency contract: a ring write donates the buffers a pending
-        dispatch would read).
+        Batch bytes never touch host RAM, and never cross DCN: the sampled
+        rows reference only their own host's slabs (sample_meta's
+        per-group quotas), so GSPMD's partitioned gather stays local in
+        practice; only grad psums (ICI/DCN) and the tiny index/min-density
+        collectives leave the host.  Steps 2-3 run under the buffer lock
+        (the device_ring concurrency contract: a ring write donates the
+        buffers a pending dispatch would read).
         """
         import jax.numpy as _jnp
 
@@ -783,8 +770,7 @@ class Learner:
         from r2d2_tpu.parallel.distributed import (
             assemble_global, global_from_local_rows, host_batch_size,
             local_rows, owned_dp_groups, sync_min_array)
-        from r2d2_tpu.parallel.mesh import sharded_super_step
-        from r2d2_tpu.replay.device_ring import ring_sharding
+        from r2d2_tpu.parallel.sharding import pjit_super_step
 
         cfg = self.cfg
         assert self.mesh is not None, "multi-host device replay needs a mesh"
@@ -810,10 +796,9 @@ class Learner:
         B, B_host = cfg.batch_size, host_batch_size(cfg, self.mesh)
         beta = cfg.importance_sampling_exponent
 
-        super_fn = sharded_super_step(cfg, self.net, self.mesh, k,
-                                      state_template=self.state,
-                                      layout="dp", blocks_per_group=bpg)
-        ring_sh = ring_sharding(self.mesh, "dp")
+        super_fn = pjit_super_step(cfg, self.net, self.table, k,
+                                   state_template=self.state, layout="dp")
+        ring_sh = self.table.ring_shardings("dp")
         dp_b = NamedSharding(self.mesh, P(None, "dp"))
         try:
             # AOT with shape specs — the global ring is far too big to

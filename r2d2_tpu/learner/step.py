@@ -35,7 +35,6 @@ from flax import struct
 
 from r2d2_tpu.config import Config
 from r2d2_tpu.models.network import R2D2Network
-from r2d2_tpu.utils.trace import RETRACES
 
 
 def value_rescale(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
@@ -197,8 +196,10 @@ def loss_and_priorities(cfg: Config, net: R2D2Network, params, target_params,
 
 
 def make_train_step(cfg: Config, net: R2D2Network):
-    """Returns ``train_step(state, batch) -> (state, loss, priorities)``,
-    ready to be wrapped in jax.jit (single-device) or pjit (mesh)."""
+    """Returns ``train_step(state, batch) -> (state, loss, priorities)``
+    — the pure function.  The ONE place it is jitted is
+    ``parallel/sharding.pjit_train_step`` (table-driven shardings,
+    state+batch donation); a 1-device mesh is the single-device case."""
     opt = make_optimizer(cfg)
     net = _loss_net(cfg, net)  # grad paths always run the scan recurrence
 
@@ -223,15 +224,6 @@ def make_train_step(cfg: Config, net: R2D2Network):
     return train_step
 
 
-def jit_train_step(cfg: Config, net: R2D2Network):
-    # retrace-guarded: the step's shapes are static per config, so any
-    # retrace after the first compile is a silent perf bug — the e2e
-    # tests assert RETRACES stays within these budgets (utils/trace.py)
-    return jax.jit(RETRACES.wrap("learner.train_step",
-                                 make_train_step(cfg, net)),
-                   donate_argnums=(0,))
-
-
 def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
     """The unjitted ``k``-fused-steps function — batches gathered in-graph
     from the device-resident replay ring (replay/device_ring.py).
@@ -244,13 +236,13 @@ def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
     advance per inner step, so k super-steps ≡ k·1 plain steps.
 
     ``gather(arrays, ints_t (B,6), w_t (B,)) -> batch`` defaults to the
-    plain in-graph gather; ``parallel.mesh.sharded_super_step`` passes a
-    shard_map-wrapped variant for dp-sharded rings.
+    plain in-graph gather (GSPMD partitions it under a dp-sharded ring —
+    no hand-written shard_map variant since r9).
 
     Signature: ``super_step(state, ring_arrays, ints (k,B,6) i32,
     is_weights (k,B) f32) -> (state, losses (k,), priorities (k,B))``.
-    Wrap with :func:`make_super_step` (single device) or
-    ``parallel.mesh.sharded_super_step`` (mesh).
+    Jitted only by ``parallel/sharding.pjit_super_step`` (table-driven
+    shardings; a 1-device mesh is the single-device case).
     """
     from r2d2_tpu.replay.device_ring import gather_batch
 
@@ -270,12 +262,6 @@ def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
         return state, losses, priorities
 
     return super_step
-
-
-def make_super_step(cfg: Config, net: R2D2Network, k: int):
-    return jax.jit(RETRACES.wrap("learner.super_step",
-                                 make_super_step_fn(cfg, net, k)),
-                   donate_argnums=(0,))
 
 
 def _compensated_cumsum(x):
@@ -314,7 +300,7 @@ def _compensated_cumsum(x):
 
 
 def _in_graph_sample_raw(cfg: Config, key, prios, seq_meta, first_burn,
-                         n_rows: int):
+                         n_rows: int, constrain_rep=None):
     """``n_rows`` stratified proportional draws from a leaf slab:
     (idx (n,), q (n,) f32 inclusion densities, ints (n, 6) i32).
     The density q = prio/mass is the *raw* per-row inclusion
@@ -325,8 +311,14 @@ def _in_graph_sample_raw(cfg: Config, key, prios, seq_meta, first_burn,
     K, L = cfg.seqs_per_block, cfg.learning_steps
     cum = _compensated_cumsum(prios)   # f64-accurate prefixes in f32
     total = cum[-1]
-    targets = (jnp.arange(n_rows, dtype=jnp.float32)
-               + jax.random.uniform(key, (n_rows,))) * (total / n_rows)
+    u = jax.random.uniform(key, (n_rows,))
+    if constrain_rep is not None:
+        # mesh mode: with non-partitionable threefry, the generated BITS
+        # change when GSPMD back-propagates a dp sharding onto this
+        # output — pinning it replicated keeps the draw bit-identical to
+        # the single-device one under every layout
+        u = constrain_rep(u)
+    targets = (jnp.arange(n_rows, dtype=jnp.float32) + u) * (total / n_rows)
     idx = jnp.searchsorted(cum, targets, side="right")
     idx = jnp.minimum(idx, prios.shape[0] - 1)
     idx = jnp.where(prios[idx] > 0, idx, jnp.argmax(prios))
@@ -345,7 +337,8 @@ def _in_graph_sample_raw(cfg: Config, key, prios, seq_meta, first_burn,
     return idx, q, ints_t
 
 
-def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
+def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn,
+                     constrain_rep=None):
     """One prioritized batch draw on-device: (idx (B,), is_weights (B,)
     f32, ints (B, 6) i32).
 
@@ -363,13 +356,15 @@ def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn):
     index arithmetic (replay_buffer.py:372-390) from the device-resident
     metadata, so ``gather_batch`` sees identical inputs either way."""
     idx, q, ints_t = _in_graph_sample_raw(
-        cfg, key, prios, seq_meta, first_burn, cfg.batch_size)
+        cfg, key, prios, seq_meta, first_burn, cfg.batch_size,
+        constrain_rep=constrain_rep)
     w = (q / q.min()) ** (-cfg.importance_sampling_exponent)
     return idx, w.astype(jnp.float32), ints_t
 
 
 def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
-                                    constrain=None):
+                                    constrain=None,
+                                    replicate_for_draw=None):
     """``k`` fused steps with DEVICE-side PER: sample → gather → step →
     priority scatter, all inside one dispatch.
 
@@ -387,6 +382,7 @@ def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
     dispatch_idx u32) -> (state, prios', losses (k,))``.  The sampling
     stream is ``fold_in(PRNGKey(cfg.seed), dispatch_idx)`` — distinct per
     dispatch with no seed/counter bit-packing to alias or overflow.
+    Jitted only by ``parallel/sharding.pjit_in_graph_per_super_step``.
     """
     from r2d2_tpu.replay.device_ring import gather_batch
 
@@ -400,8 +396,18 @@ def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
 
         def body(carry, key_t):
             st, p = carry
-            idx, w, ints_t = _in_graph_sample(cfg, key_t, p, seq_meta,
-                                              first_burn)
+            # mesh mode: the draw runs over a REPLICATED view of the
+            # priority leaves — _compensated_cumsum's associative_scan
+            # changes tree shape (and so its final-ulp rounding) when
+            # GSPMD partitions it, and an ulp at a stratum boundary
+            # flips which slot that stratum draws.  Replicating the
+            # (leaves,)-sized scan makes the draw bit-identical under
+            # every layout for pennies; the gather/forward stay sharded.
+            p_draw = p if replicate_for_draw is None else (
+                replicate_for_draw(p))
+            idx, w, ints_t = _in_graph_sample(
+                cfg, key_t, p_draw, seq_meta, first_burn,
+                constrain_rep=replicate_for_draw)
             if constrain is not None:
                 # mesh mode: the (replicated) sampled bundle's batch rows
                 # are pinned to dp here, so GSPMD shards the gather and
@@ -420,10 +426,3 @@ def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
         return state, prios, losses
 
     return super_step
-
-
-def make_in_graph_per_super_step(cfg: Config, net: R2D2Network, k: int):
-    return jax.jit(RETRACES.wrap("learner.in_graph_per_super_step",
-                                 make_in_graph_per_super_step_fn(cfg, net,
-                                                                 k)),
-                   donate_argnums=(0, 2))

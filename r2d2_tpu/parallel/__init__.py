@@ -4,22 +4,33 @@ from r2d2_tpu.parallel.distributed import (
     init_distributed,
     sync_counter,
 )
-from r2d2_tpu.parallel.mesh import (
-    batch_sharding,
-    make_mesh,
-    replicated,
+from r2d2_tpu.parallel.mesh import AXES, make_mesh, replicated, trivial_mesh
+from r2d2_tpu.parallel.sharding import (
+    DEVICE_BATCH_KEYS,
+    ShardingTable,
+    UnresolvedShardingError,
+    parse_table,
+    pjit_in_graph_per_super_step,
+    pjit_super_step,
+    pjit_train_step,
     shard_batch,
-    sharded_train_step,
 )
 
 __all__ = [
-    "batch_sharding",
+    "AXES",
+    "DEVICE_BATCH_KEYS",
+    "ShardingTable",
+    "UnresolvedShardingError",
     "dp_rows_for_process",
     "host_local_batch",
     "init_distributed",
     "make_mesh",
+    "parse_table",
+    "pjit_in_graph_per_super_step",
+    "pjit_super_step",
+    "pjit_train_step",
     "replicated",
     "shard_batch",
-    "sharded_train_step",
     "sync_counter",
+    "trivial_mesh",
 ]

@@ -11,7 +11,8 @@ multi-node story at all.  The TPU-native equivalent splits cleanly:
   ``jax.distributed.initialize`` so N host processes (one per TPU host)
   form a single JAX runtime whose ``jax.devices()`` is the global device
   set.  After it returns, ``make_mesh`` over ``jax.devices()`` is a global
-  mesh and the existing ``sharded_train_step`` compiles unchanged.
+  mesh and the table-driven ``parallel/sharding.pjit_train_step``
+  compiles unchanged.
 - **Host-side data plane**: replay stays host-local (each host's actor
   fleet feeds its own buffer — the analogue of the reference's per-actor
   queues staying on one box).  ``cfg.batch_size`` remains the **global**
@@ -42,7 +43,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from r2d2_tpu.config import Config
-from r2d2_tpu.parallel.mesh import DEVICE_BATCH_KEYS, batch_sharding
+from r2d2_tpu.parallel.sharding import DEVICE_BATCH_KEYS, ShardingTable
 
 
 def _distributed_initialized() -> bool:
@@ -204,11 +205,11 @@ def host_local_batch(mesh: Mesh, local_batch: Dict[str, np.ndarray],
     ``local_batch`` holds only this process's rows (``host_batch_size`` of
     them).  Single-process, the local rows are the whole batch and the
     result equals a sharded ``jax.device_put``.  Pass cached ``shardings``
-    (``batch_sharding(mesh)``) from hot paths to avoid rebuilding them
-    per step.
+    (``ShardingTable.batch_shardings()``) from hot paths to avoid
+    rebuilding them per step.
     """
     if shardings is None:
-        shardings = batch_sharding(mesh)
+        shardings = ShardingTable(mesh).batch_shardings()
     return {
         k: jax.make_array_from_process_local_data(shardings[k],
                                                   local_batch[k])

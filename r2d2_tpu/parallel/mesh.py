@@ -1,22 +1,25 @@
-"""Device-mesh parallelism for the learner.
+"""Learner device-mesh construction.
 
-The reference learner is a single device (worker.py:283-285); this module is
-the framework's first new parallelism axis (SURVEY.md §2): **learner data
-parallelism over a ``jax.sharding.Mesh``**, expressed as GSPMD shardings on
-the jitted train step rather than hand-written collectives.
+The reference learner is a single device (worker.py:283-285); here the
+learner is one GSPMD program over a 3-axis mesh:
 
-Design:
-- The training batch is sharded along the leading batch axis over the
-  ``"dp"`` mesh axis; params/opt state are replicated.
-- The loss is a *global* masked mean and priorities are per-sample, so the
-  same :func:`r2d2_tpu.learner.step.make_train_step` function compiles
-  unchanged under a mesh — XLA inserts the gradient ``psum`` and the
-  loss-normalisation collectives over ICI.  No NCCL/MPI translation, no
-  per-device bookkeeping in user code.
-- ``mesh_shape`` comes from config (e.g. ``(("dp", 8),)``); the default is
-  all local devices on ``dp``.  Axes other than ``"dp"`` are accepted and
-  currently used only for parameter replication-groups (a ``"mp"`` axis is
-  reserved for sharding the LSTM 4H kernel when models outgrow one chip).
+- ``dp``   — data parallelism (batch rows, ring slots, gradient psums),
+- ``fsdp`` — parameter/optimizer-moment sharding for memory,
+- ``tp``   — Megatron-style tensor parallelism for the LSTM 4H kernels
+  and dense output dims.
+
+Which parameter goes where is NOT decided here: the declarative sharding
+table in :mod:`r2d2_tpu.parallel.sharding` maps param-path patterns to
+``PartitionSpec``s, and the single table-driven
+``jit(in_shardings=..., out_shardings=...)`` train step replaces the
+pmap/shard_map-era variants this module used to carry (the retired
+``mp`` heuristic, the shard_map ring gathers).
+
+``mesh_shape`` comes from config (e.g. ``(("dp", 4), ("tp", 2))``);
+missing axes default to size 1, an empty spec puts all local devices on
+``dp``.  The mesh ALWAYS carries all three axes so sharding-table specs
+resolve uniformly — a 1-device :func:`trivial_mesh` makes the
+single-device learner the degenerate case of the same code path.
 
 Multi-host: the same code runs under ``jax.distributed`` with a global
 mesh; batches then arrive per-host and shardings ride ICI within a slice
@@ -25,358 +28,51 @@ and DCN across slices.  Nothing here assumes single-process.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from r2d2_tpu.config import Config
-from r2d2_tpu.learner.step import TrainState, make_train_step
-from r2d2_tpu.models.network import R2D2Network
-from r2d2_tpu.utils.trace import RETRACES
+from r2d2_tpu.config import Config, MESH_AXES, validate_mesh_shape
 
-# device-batch fields (everything else in a replay batch is host-only
-# bookkeeping: idxes, block_ptr, env_steps)
-DEVICE_BATCH_KEYS = (
-    "obs", "last_action", "last_reward", "hidden", "action",
-    "n_step_reward", "n_step_gamma", "burn_in", "learning", "forward",
-    "is_weights",
-)
+# the canonical learner mesh axes, in layout order (single-sourced in
+# config.py so Config validation needs no jax import)
+AXES = MESH_AXES
 
 
 def make_mesh(cfg: Config, devices: Optional[Sequence[Any]] = None) -> Mesh:
-    """Build the learner mesh from ``cfg.mesh_shape``.
+    """Build the 3-axis learner mesh from ``cfg.mesh_shape``.
 
-    Empty ``mesh_shape`` (the default) → all available devices on ``"dp"``.
+    Empty ``mesh_shape`` (the default) → all available devices on
+    ``"dp"``, ``fsdp = tp = 1``.  Named axes must be in :data:`AXES`
+    (validated at Config construction too); omitted axes get size 1.
     """
     devices = list(devices if devices is not None else jax.devices())
-    spec = cfg.mesh_shape or (("dp", len(devices)),)
-    names = tuple(name for name, _ in spec)
-    sizes = tuple(size for _, size in spec)
-    need = math.prod(sizes)
+    sizes = validate_mesh_shape(cfg.mesh_shape)
+    if not cfg.mesh_shape:
+        sizes["dp"] = len(devices)
+    resolved = tuple(sizes[name] or 1 for name in AXES)
+    need = math.prod(resolved)
     if need > len(devices):
         raise ValueError(
-            f"mesh_shape {spec} needs {need} devices, have {len(devices)}")
-    arr = np.asarray(devices[:need], dtype=object).reshape(sizes)
-    return Mesh(arr, names)
+            f"mesh_shape {cfg.mesh_shape} needs {need} devices, "
+            f"have {len(devices)}")
+    arr = np.asarray(devices[:need], dtype=object).reshape(resolved)
+    return Mesh(arr, AXES)
+
+
+def trivial_mesh(device: Optional[Any] = None) -> Mesh:
+    """A 1×1×1 mesh over one device: the single-device learner runs the
+    SAME table-driven pjit step as a pod — no separate code path.
+
+    Defaults to this process's first LOCAL device: a mesh-less learner
+    under an initialized ``jax.distributed`` runtime is an independent
+    process-local learner, and ``jax.devices()[0]`` would be
+    non-addressable on processes != 0."""
+    device = device if device is not None else jax.local_devices()[0]
+    return Mesh(np.asarray([device], dtype=object).reshape(1, 1, 1), AXES)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
-
-
-# ---------------------------------------------------------------------------
-# model parallelism: parameter sharding rules over the "mp" axis
-# ---------------------------------------------------------------------------
-
-def _param_spec(path: Tuple[Any, ...], leaf, mp: int) -> P:
-    """PartitionSpec for one parameter (or optimizer-moment) leaf.
-
-    The rule shards every large matmul kernel on its OUTPUT dimension over
-    ``mp`` — the classic Megatron column split, expressed as a GSPMD
-    annotation (XLA inserts the all-gathers/reduce-scatters):
-
-    - LSTM ``wi`` (F, 4H) and ``wh`` (H, 4H): last dim over mp.  The gate
-      nonlinearities are elementwise in the 4H dim, so the split is clean.
-    - Dense ``kernel`` (F, O): last dim over mp (torso FC and head hiddens
-      dominate; tiny output heads fall back to replication via the
-      divisibility guard).
-    - Conv kernels, biases, scalars: replicated.  Conv compute is batch-
-      dominated and already split by dp; biases are small.
-
-    Anything whose dim is not divisible by ``mp`` is replicated — semantics
-    are identical either way, this is purely a layout choice.
-    """
-    names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
-    shape = getattr(leaf, "shape", ())
-    if len(shape) == 2 and shape[-1] % mp == 0 and (
-            "wi" in names or "wh" in names or "kernel" in names):
-        return P(None, "mp")
-    return P()
-
-
-def state_shardings(mesh: Mesh, state) -> Any:
-    """A TrainState-shaped tree of NamedShardings under the param rule.
-
-    Works for ``params``, ``target_params``, and the optimizer moments
-    without special-casing optax internals: adam's ``mu``/``nu`` subtrees
-    carry the same trailing key paths as the params they mirror, so the
-    path-based rule lands on them identically (moments must share their
-    param's layout or every update would reshard).
-    """
-    if "mp" not in mesh.axis_names:
-        return jax.tree.map(lambda _: replicated(mesh), state)
-    mp = mesh.shape["mp"]
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(mesh, _param_spec(path, leaf, mp)),
-        state)
-
-
-def batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
-    """Leading-axis ``dp`` sharding for every device-batch field."""
-    dp = NamedSharding(mesh, P("dp"))
-    return {k: dp for k in DEVICE_BATCH_KEYS}
-
-
-def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
-    """Host batch → device batch: strip host-only fields, place shards.
-
-    ``jax.device_put`` with a NamedSharding splits the host array across
-    the ``dp`` devices (the H2D analogue of worker.py:330-342, minus the
-    fields the TPU step never needs).
-    """
-    shardings = batch_sharding(mesh)
-    return {k: jax.device_put(batch[k], shardings[k])
-            for k in DEVICE_BATCH_KEYS}
-
-
-def _validate_mesh_step(cfg: Config, mesh: Mesh,
-                        state_template: Optional[TrainState]):
-    """Shared guards + state sharding of every mesh-compiled step entry
-    (sharded_train_step / sharded_super_step /
-    sharded_in_graph_per_super_step): batch divisibility over dp, the mp
-    state-template requirement, and the replicated-or-derived state
-    sharding."""
-    if cfg.batch_size % mesh.shape["dp"] != 0:
-        raise ValueError(
-            f"batch_size {cfg.batch_size} not divisible by "
-            f"dp={mesh.shape['dp']}")
-    if "mp" in mesh.axis_names and state_template is None:
-        raise ValueError("an mp mesh needs state_template to derive "
-                         "per-parameter shardings")
-    return (state_shardings(mesh, state_template)
-            if state_template is not None else replicated(mesh))
-
-
-def sharded_train_step(cfg: Config, net: R2D2Network, mesh: Mesh,
-                       state_template: Optional[TrainState] = None):
-    """The jitted train step compiled over the mesh.
-
-    Same function as the single-device step; only shardings differ.  The
-    per-device batch is ``batch_size // dp``; with an ``mp`` axis the big
-    kernels (and their optimizer moments) additionally shard over mp per
-    :func:`_param_spec`.  Semantics are identical to the single-device
-    step because loss/priorities are computed with global reductions
-    (verified in tests/test_parallel.py).
-
-    ``state_template`` (shapes only — ``jax.eval_shape`` output is fine)
-    is required when the mesh has an ``mp`` axis so per-leaf shardings can
-    be derived; a dp-only mesh replicates the whole state.
-    """
-    st_shard = _validate_mesh_step(cfg, mesh, state_template)
-    step = make_train_step(cfg, net)  # _loss_net routes scan
-    repl = replicated(mesh)
-    dp = NamedSharding(mesh, P("dp"))
-    return jax.jit(
-        RETRACES.wrap("mesh.train_step", step),
-        in_shardings=(st_shard, {k: dp for k in DEVICE_BATCH_KEYS}),
-        out_shardings=(st_shard, repl, dp),
-        donate_argnums=(0,),
-    )
-
-
-def sharded_super_step(cfg: Config, net: R2D2Network, mesh: Mesh, k: int,
-                       state_template: Optional[TrainState] = None,
-                       layout: str = "replicated",
-                       blocks_per_group: Optional[int] = None):
-    """The device-replay super-step compiled over the mesh.
-
-    The index bundles and is_weights shard their batch axis (axis 1) over
-    ``dp``; params follow the same rules as :func:`sharded_train_step`, so
-    grad psums ride ICI exactly as in the host-staged path.  The HBM ring
-    follows ``layout`` (replay/device_ring.ring_sharding):
-
-    - ``"replicated"``: every device holds the full ring (writes broadcast
-      once per block); the plain in-graph gather produces a dp-sharded
-      batch with no collectives — each device gathers its rows from its
-      local replica.
-    - ``"dp"``: the slot axis shards over dp — capacity scales with the
-      mesh.  The gather runs inside ``shard_map``: each dp group receives
-      its slot slab plus its rows of the index bundle (the ReplayBuffer
-      samples row chunk g from group g's slots — replay_buffer.sample_meta)
-      and localises the global slot index by its ``axis_index("dp")``
-      offset.  Still no collectives in the data plane; only the grad psum
-      crosses ICI.
-
-    ``blocks_per_group`` defaults to ``cfg.num_blocks // dp``
-    (single-process, where cfg.num_blocks is the whole ring).  Multi-host
-    device replay passes it explicitly: there cfg.num_blocks is the
-    PER-HOST ring and the global slot axis is the concatenation of every
-    host's slabs (learner/learner.py).
-    """
-    dp = mesh.shape["dp"]
-    st_shard = _validate_mesh_step(cfg, mesh, state_template)
-    from r2d2_tpu.learner.step import make_super_step_fn
-    from r2d2_tpu.replay.device_ring import gather_batch, ring_sharding
-
-    gather = None
-    if layout == "dp":
-        from jax import shard_map
-
-        if blocks_per_group is None:
-            if cfg.num_blocks % dp:
-                raise ValueError(
-                    f"layout='dp' needs num_blocks ({cfg.num_blocks}) "
-                    f"divisible by dp={dp}")
-            blocks_per_group = cfg.num_blocks // dp
-
-        def local_gather(arrays, ints_t, w_t):
-            gid = jax.lax.axis_index("dp")
-            ints_local = ints_t.at[:, 0].add(-gid * blocks_per_group)
-            return gather_batch(cfg, arrays, ints_local, w_t)
-
-        def gather(arrays, ints_t, w_t):
-            # in/out specs as pytree prefixes: ring slot axis and batch
-            # rows split over dp; mp (if present) sees replicated inputs
-            # and identical outputs, which varying-axis inference proves
-            return shard_map(
-                local_gather, mesh=mesh,
-                in_specs=(P("dp"), P("dp"), P("dp")),
-                out_specs=P("dp"))(arrays, ints_t, w_t)
-
-    fn = make_super_step_fn(cfg, net, k,
-                            gather=gather)
-    repl = replicated(mesh)
-    dp_b = NamedSharding(mesh, P(None, "dp"))
-    return jax.jit(
-        RETRACES.wrap("mesh.super_step", fn),
-        in_shardings=(st_shard, ring_sharding(mesh, layout), dp_b, dp_b),
-        out_shardings=(st_shard, repl, dp_b),
-        donate_argnums=(0,),
-    )
-
-
-def sharded_in_graph_per_super_step(cfg: Config, net: R2D2Network,
-                                    mesh: Mesh, k: int,
-                                    state_template: Optional[TrainState]
-                                    = None, layout: str = "replicated",
-                                    blocks_per_group: Optional[int] = None):
-    """The device-PER super-step (learner/step.py:
-    make_in_graph_per_super_step_fn) compiled over the mesh.
-
-    ``layout="replicated"``: the PER state (priorities, sampling
-    metadata) is tiny and replicated; sampling executes identically on
-    every device (same fold_in key → same stratified draws), then the
-    bundle's batch rows are sharding-constrained to dp so GSPMD shards
-    the gather and the forward/backward exactly as the host-sampled path
-    does.
-
-    ``layout="dp"``: the ring AND the PER leaves shard their slot axis
-    over dp — capacity scales with the mesh, and sampling goes
-    per-group: inside ``shard_map``, dp group g draws its B/dp batch
-    rows from its own leaf slab (fold_in by ``axis_index("dp")`` gives
-    each group an independent stream), exactly the host dp path's
-    fixed-quota scheme (replay_buffer.sample_meta: priority-driven
-    *within* each slab, B/G rows per slab).  IS weights min-normalise
-    the raw inclusion densities across the WHOLE batch — ``jnp.min``
-    over the dp-sharded density rows, which GSPMD realises as the one
-    tiny cross-group collective in the data plane (on a multi-host mesh
-    this is the only PER traffic that crosses DCN).  Gather and priority
-    scatter run in per-group ``shard_map`` regions on local indices — no
-    collectives.  This is the composition the reference cannot express:
-    pod-scale replay capacity (train.py:23-26's 2M transitions and far
-    beyond) with zero host round trips in the priority loop.
-    """
-    st_shard = _validate_mesh_step(cfg, mesh, state_template)
-    from r2d2_tpu.learner.step import make_in_graph_per_super_step_fn
-    from r2d2_tpu.replay.device_ring import per_sharding, ring_sharding
-
-    repl = replicated(mesh)
-    if layout == "replicated":
-        dp_rows = NamedSharding(mesh, P("dp"))
-
-        def constrain(ints_t, w_t):
-            return (jax.lax.with_sharding_constraint(ints_t, dp_rows),
-                    jax.lax.with_sharding_constraint(w_t, dp_rows))
-
-        fn = make_in_graph_per_super_step_fn(
-            cfg, net, k, constrain=constrain)
-        return jax.jit(
-            RETRACES.wrap("mesh.in_graph_per_super_step", fn),
-            in_shardings=(st_shard, ring_sharding(mesh, "replicated"),
-                          repl, repl, repl, repl),
-            out_shardings=(st_shard, repl, repl),
-            donate_argnums=(0, 2),
-        )
-    if layout != "dp":
-        raise ValueError(f"unknown in-graph PER layout {layout!r}")
-
-    from jax import shard_map
-
-    from r2d2_tpu.learner.step import _in_graph_sample_raw
-    from r2d2_tpu.replay.device_ring import gather_batch
-
-    dp = mesh.shape["dp"]
-    if blocks_per_group is None:
-        if cfg.num_blocks % dp:
-            raise ValueError(
-                f"layout='dp' needs num_blocks ({cfg.num_blocks}) "
-                f"divisible by dp={dp}")
-        blocks_per_group = cfg.num_blocks // dp
-    B = cfg.batch_size
-    Bg = B // dp
-    beta = cfg.importance_sampling_exponent
-    step = make_train_step(cfg, net)  # _loss_net routes scan
-    per_sh = per_sharding(mesh, "dp")
-    dp_rows = NamedSharding(mesh, P("dp"))
-
-    def local_sample(key_t, p_g, meta_g, first_g):
-        gid = jax.lax.axis_index("dp")
-        idx, q, ints_t = _in_graph_sample_raw(
-            cfg, jax.random.fold_in(key_t, gid), p_g, meta_g, first_g, Bg)
-        return idx, q, ints_t
-
-    def local_gather(arrays_g, ints_g, w_g):
-        # sampled indices are already group-local — no offset to undo
-        return gather_batch(cfg, arrays_g, ints_g, w_g)
-
-    def local_scatter(p_g, idx_g, new_p_g):
-        return p_g.at[idx_g].set(new_p_g ** cfg.prio_exponent)
-
-    def super_step(state, arrays, prios, seq_meta, first_burn,
-                   dispatch_idx):
-        keys = jax.random.split(
-            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), dispatch_idx),
-            k)
-
-        def body(carry, key_t):
-            st, p = carry
-            idx, q, ints_t = shard_map(
-                local_sample, mesh=mesh,
-                in_specs=(P(), P("dp"), P("dp"), P("dp")),
-                out_specs=P("dp"))(key_t, p, seq_meta, first_burn)
-            # reference IS scheme across the WHOLE pod batch: one global
-            # min over the dp-sharded densities (the only collective in
-            # the PER loop), then w = (q/min)^-beta elementwise
-            w = ((q / jnp.min(q)) ** (-beta)).astype(jnp.float32)
-            batch = shard_map(
-                local_gather, mesh=mesh,
-                in_specs=(P("dp"), P("dp"), P("dp")),
-                out_specs=P("dp"))(arrays, ints_t, w)
-            st, loss, new_p = step(st, batch)
-            p = shard_map(
-                local_scatter, mesh=mesh,
-                in_specs=(P("dp"), P("dp"), P("dp")),
-                out_specs=P("dp"))(p, idx, new_p)
-            return (st, p), loss
-
-        (state, prios), losses = jax.lax.scan(body, (state, prios), keys)
-        return state, prios, losses
-
-    return jax.jit(
-        RETRACES.wrap("mesh.in_graph_per_super_step", super_step),
-        in_shardings=(st_shard, ring_sharding(mesh, "dp"),
-                      per_sh["prios"], per_sh["seq_meta"],
-                      per_sh["first"], repl),
-        out_shardings=(st_shard, per_sh["prios"], repl),
-        donate_argnums=(0, 2),
-    )
-
-
-def replicate_state(mesh: Mesh, state: TrainState) -> TrainState:
-    """Place a host/single-device TrainState onto the mesh with the layout
-    :func:`sharded_train_step` expects (replicated on dp-only meshes,
-    kernel-sharded when the mesh has an mp axis)."""
-    return jax.device_put(state, state_shardings(mesh, state))

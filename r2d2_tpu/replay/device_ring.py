@@ -32,8 +32,11 @@ Capacity envelope — two mesh layouts (``layout=``):
   rows from its own leaf slice (``SumTree.sample_range``, IS weights
   min-normalised across the whole batch), and maps physical slots back to
   the logical FIFO walk for stale-feedback masking.  The in-graph gather
-  runs inside ``shard_map`` — each dp group reads only its local shard,
-  no collectives (parallel.mesh.sharded_super_step(layout="dp")).
+  uses GLOBAL slot indices under GSPMD — the sharding table declares the
+  slot-axis layout (``ring.*`` entries, parallel/sharding.py) and XLA
+  partitions the gather; because each dp group's sampled rows reference
+  only its own slab (sample_meta's per-group quota), the partitioned
+  gather stays local in practice, with no hand-written shard_map.
 
 Multi-host meshes compose the same layout across processes: each host
 builds a dp ring over its LOCAL submesh (its dp groups' slabs) and fills
@@ -66,11 +69,12 @@ import numpy as np
 from r2d2_tpu.config import Config
 from r2d2_tpu.replay.block import Block
 
-# data arrays mirrored on device, (name, per-block shape fn, dtype);
-# the count arrays (burn_in/learning/forward, first_burn_in) stay host-only
-# — they are needed for *index computation*, which is host work.
-_DATA_KEYS = ("obs", "last_action", "last_reward", "action",
-              "n_step_reward", "n_step_gamma", "hidden")
+# data arrays mirrored on device; the count arrays (burn_in/learning/
+# forward, first_burn_in) stay host-only — they are needed for *index
+# computation*, which is host work.  Single-sourced from the sharding
+# table's RING_DATA_KEYS so the ring's slabs and the table's `ring.*`
+# sharding entries can never drift.
+from r2d2_tpu.parallel.sharding import RING_DATA_KEYS as _DATA_KEYS
 
 
 def _slot_shapes(cfg: Config, action_dim: int) -> Dict[str, Any]:
@@ -132,40 +136,6 @@ def gather_batch(cfg: Config, arrays: Dict[str, jnp.ndarray],
     )
 
 
-def ring_sharding(mesh, layout: str = "replicated") -> Dict[str, Any]:
-    """Mesh sharding for every ring array.
-
-    "replicated": each device holds the full ring — gathers need no
-    collectives, capacity is bounded by one chip's HBM.
-    "dp": the slot axis shards over ``dp`` — capacity scales with the
-    mesh; each dp group gathers only from its own shard (via shard_map in
-    ``parallel.mesh.sharded_super_step``) and sampling draws each group's
-    batch rows from its own slot range.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    if layout not in ("replicated", "dp"):
-        raise ValueError(f"unknown device-ring layout {layout!r} "
-                         "(expected 'replicated' or 'dp')")
-    spec = (PartitionSpec("dp") if layout == "dp" else PartitionSpec())
-    sh = NamedSharding(mesh, spec)
-    return {k: sh for k in _DATA_KEYS}
-
-
-def per_sharding(mesh, layout: str = "replicated") -> Dict[str, Any]:
-    """Mesh shardings for the in-graph PER state: ``prios`` (NB*K,),
-    ``seq_meta`` (NB, K, 3), ``first`` (NB,).  Under ``layout="dp"`` all
-    three shard their leading (slot/leaf) axis over dp, aligned with the
-    ring slabs: group g's slots [g·bpg, (g+1)·bpg) own leaves
-    [g·bpg·K, (g+1)·bpg·K) — the flat leaf axis splits exactly at slab
-    boundaries because K divides each shard."""
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    spec = (PartitionSpec("dp") if layout == "dp" else PartitionSpec())
-    sh = NamedSharding(mesh, spec)
-    return dict(prios=sh, seq_meta=sh, first=sh)
-
-
 def resolve_layout(cfg: Config, mesh, need_bytes: int,
                    cap_bytes: Optional[int]) -> str:
     """Resolve ``cfg.device_ring_layout`` to a concrete mesh layout.
@@ -221,35 +191,36 @@ class DeviceRing:
     """Owns the device-resident ring arrays and their write path.
 
     ``placement`` may be a Device (single-chip) or a Sharding; use
-    ``mesh=..., layout=...`` instead to derive it (see
-    :func:`ring_sharding`).  ``layout="dp"`` additionally sets
-    ``num_groups`` — the replay buffer then walks ring slots round-robin
-    across the dp groups' slot ranges and samples each group's batch rows
-    from its own slots.
+    ``table=..., layout=...`` (a :class:`~r2d2_tpu.parallel.sharding.
+    ShardingTable`) instead to derive it — the ring's slot-axis layout is
+    a sharding-table decision (``ring.*`` / ``per.*`` entries), not a
+    local heuristic.  ``layout="dp"`` additionally sets ``num_groups`` —
+    the replay buffer then walks ring slots round-robin across the dp
+    groups' slot ranges and samples each group's batch rows from its own
+    slots.
     """
 
     def __init__(self, cfg: Config, action_dim: int,
                  placement: Optional[Any] = None,
-                 mesh: Optional[Any] = None, layout: str = "replicated"):
+                 table: Optional[Any] = None, layout: str = "replicated"):
         self.cfg = cfg
         self.action_dim = action_dim
         self.layout = layout
         self.num_groups = 1
+        self.table = table
         self._slot_placement = placement  # incoming slots: device or repl.
         self._write_fn = _write_slot
-        if mesh is not None:
+        if table is not None:
             if layout == "dp":
-                dp = mesh.shape["dp"]
+                dp = table.mesh.shape["dp"]
                 if cfg.num_blocks % dp:
                     raise ValueError(
                         f"device_ring_layout='dp' needs num_blocks "
                         f"({cfg.num_blocks}) divisible by dp={dp}")
                 self.num_groups = dp
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            sharding = ring_sharding(mesh, layout)
+            sharding = table.ring_shardings(layout)
             placement = sharding["obs"]
-            self._slot_placement = NamedSharding(mesh, PartitionSpec())
+            self._slot_placement = table.replicated()
             # pin the write's output layout: GSPMD would usually preserve
             # the donated input sharding, but with a dp-sharded slot axis
             # the partitioner must not be left free to re-lay-out the ring
@@ -269,7 +240,7 @@ class DeviceRing:
         # per-sequence window metadata the in-graph sampler needs to
         # build index bundles without the host (learner/step.py
         # _in_graph_sample).  Replicated under a mesh; dp layout shards
-        # the leaf axis with the ring slabs (per_sharding).
+        # the leaf axis with the ring slabs (the table's per.* entries).
         # The priorities handle is READ-WRITE from the learner's super
         # step (donated carry) AND written by actor block commits —
         # both sides mutate it only under the module's coordinating
@@ -279,9 +250,9 @@ class DeviceRing:
             K = cfg.seqs_per_block
             if self.num_groups > 1:
                 # dp layout: the PER leaves shard with the ring slabs —
-                # the grouped in-graph sampler draws each group's rows
-                # from its own slab shard (parallel.mesh, layout="dp")
-                psh = per_sharding(mesh, "dp")
+                # the global stratified sampler reads them through GSPMD
+                # (parallel/sharding.pjit_in_graph_per_super_step)
+                psh = table.per_shardings("dp")
                 self._per_prios = jax.device_put(
                     np.zeros((NB * K,), np.float32), psh["prios"])
                 self._per_seq_meta = jax.device_put(

@@ -115,6 +115,14 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
         start_minutes = float(meta.get("minutes", 0.0))
 
     mesh = make_mesh(cfg) if use_mesh else None
+    # ONE sharding table per bring-up: every sharding constructor (the
+    # pjit steps, the DeviceRing slot/PER layouts, checkpoint
+    # re-placement) resolves through it (parallel/sharding.py).  On a
+    # 1-device trivial mesh it degenerates to all-replicated.
+    from r2d2_tpu.parallel.mesh import trivial_mesh
+    from r2d2_tpu.parallel.sharding import ShardingTable
+
+    table = ShardingTable(mesh if mesh is not None else trivial_mesh(), cfg)
     if mesh is not None:
         from r2d2_tpu.parallel.distributed import host_batch_size
 
@@ -160,7 +168,7 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                 f"{cap / 1e9:.1f} GB; falling back to host replay — "
                 "reduce buffer_capacity to fit", stacklevel=2)
         else:
-            ring = (DeviceRing(cfg, action_dim, mesh=mesh, layout=layout)
+            ring = (DeviceRing(cfg, action_dim, table=table, layout=layout)
                     if mesh is not None else DeviceRing(cfg, action_dim))
     elif cfg.device_replay:
         # multi-host: each host owns the slot slabs of its dp groups — a
@@ -192,7 +200,9 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
             # must push the whole pod to host staging, not deadlock it
             ok = sync_counter(int(shapes_ok and fits), reduce="min") > 0
             if ok:
-                ring = DeviceRing(cfg, action_dim, mesh=lmesh, layout="dp")
+                ring = DeviceRing(cfg, action_dim,
+                                  table=ShardingTable(lmesh, cfg),
+                                  layout="dp")
             else:
                 warnings.warn(
                     "multi-host device_replay disabled (on at least one "
@@ -221,7 +231,7 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
     learner = Learner(cfg, net, state, mesh=mesh, param_store=param_store,
                       checkpointer=checkpointer,
                       start_env_steps=start_env_steps,
-                      start_minutes=start_minutes)
+                      start_minutes=start_minutes, table=table)
     buffer = ReplayBuffer(cfg, action_dim,
                           rng=np.random.default_rng(cfg.seed),
                           device_ring=ring)
@@ -303,6 +313,137 @@ def _device_memory_bytes():
         return int(stats["bytes_limit"]) if stats else None
     except Exception:
         return None
+
+
+class _HostScaffold:
+    """Host-side scaffolding shared by every trainer variant (the
+    extraction ROADMAP item 2 flagged, done before a third variant
+    appears).
+
+    Owns the pieces ``train()`` and ``_train_anakin`` used to duplicate:
+    the stop predicate (event + wall-clock deadline + supervisor failure),
+    the SIGTERM/SIGINT drain-then-save handlers, the learner Heartbeat and
+    its stall-watchdog loop, the bounded in-memory log ring, the telemetry
+    plane (registry/JSONL/exporter) with the supervisor's give-up stamping
+    wired in, and the quiesce/teardown order.  Trainer-specific policy —
+    the /healthz verdict, the log-loop body, extra fabric loops, chaos
+    wiring — stays in the trainer; the scaffold only runs what it is
+    handed."""
+
+    def __init__(self, cfg: Config, checkpoint_dir: Optional[str],
+                 max_wall_seconds: Optional[float] = None,
+                 max_thread_restarts: int = 3,
+                 signal_msg: str = "draining fabric, then saving full state",
+                 watch_label: str = "learner"):
+        self.cfg = cfg
+        self.telemetry = Telemetry(cfg, checkpoint_dir)
+        # a thread exhausting its restart budget is stamped straight into
+        # the registry by the supervisor itself — the log loop (the usual
+        # absorption path) may be the very thread that died
+        self.supervisor = Supervisor(
+            max_restarts=max_thread_restarts,
+            on_giveup=lambda name: self.telemetry.registry.inc(
+                "supervisor.gaveup", thread=name))
+        self.stop_event = threading.Event()
+        self.deadline = (time.time() + max_wall_seconds
+                         if max_wall_seconds else None)
+        # learner liveness: the learner beats through every stop poll
+        # (loop iterations AND queue waits), so a stale heartbeat means a
+        # genuinely frozen thread — wedged collective, dead interconnect,
+        # chaos freeze — not a slow batch
+        self.heartbeat = Heartbeat()
+        self.stall = {"stalled": False}
+        # bounded ring (cfg.log_history_cap): the JSONL run log is the
+        # durable record; this is the in-memory tail metrics["logs"]
+        # returns
+        self.logs: collections.deque = collections.deque(
+            maxlen=cfg.log_history_cap)
+        self._signal_msg = signal_msg
+        self._watch_label = watch_label
+        self._prev_handlers: Dict[int, Any] = {}
+
+    def stop(self) -> bool:
+        return (self.stop_event.is_set() or self.supervisor.any_failed
+                or (self.deadline is not None
+                    and time.time() > self.deadline))
+
+    def install_signals(self) -> None:
+        """SIGTERM/SIGINT request a drain-then-save shutdown.  Signals
+        only reach the main thread; a trainer driven from a worker thread
+        (tests, sweep) skips the hook.  Handlers stay installed through
+        the post-drain save — a second SIGTERM during the drain must keep
+        requesting a clean stop, not kill the process mid-write — and
+        :meth:`close` restores them on every exit path."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _on_signal(signum, frame):
+            log.warning("signal %d: %s", signum, self._signal_msg)
+            self.stop_event.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = signal.signal(sig, _on_signal)
+            except (ValueError, OSError):  # exotic embedding: no signals
+                pass
+
+    def _learner_watch(self) -> None:
+        cfg = self.cfg
+        poll = min(0.05, cfg.learner_stall_timeout / 4)
+        while not self.stop():
+            time.sleep(poll)
+            if self.heartbeat.age() > cfg.learner_stall_timeout:
+                self.stall["stalled"] = True
+                log.error("%s heartbeat stale for %.1fs (budget %.1fs): "
+                          "declaring a stall and stopping the fabric",
+                          self._watch_label, self.heartbeat.age(),
+                          cfg.learner_stall_timeout)
+                self.stop_event.set()
+                return
+
+    def watch_loops(self) -> List[Any]:
+        """The heartbeat stall-watchdog loop (empty when disabled)."""
+        return ([("learner_watch", self._learner_watch)]
+                if self.cfg.learner_stall_timeout > 0 else [])
+
+    def exporter_loops(self, healthz: Callable[[], Dict[str, Any]]
+                       ) -> List[Any]:
+        """Arm the HTTP exporter around the trainer's healthz verdict.
+        The loop is close-driven, NOT stop-driven: a stalled/stopping run
+        must stay scrapeable (that is when /healthz matters most); quiesce
+        closes the exporter before joining it."""
+        exporter = self.telemetry.serve(healthz)
+        if exporter is None:    # telemetry_port == 0
+            return []
+
+        def telemetry_loop():
+            while not exporter.closed:
+                try:
+                    exporter.handle_once()
+                except (OSError, ValueError):
+                    return        # server closed under a late poll
+
+        return [("telemetry", telemetry_loop)]
+
+    def start(self, loops) -> None:
+        for name, loop in loops:
+            self.supervisor.start(name, loop)
+
+    def quiesce(self) -> None:
+        """Stop, then close the exporter BEFORE join_all — the telemetry
+        loop exits on close, and a joined-but-serving exporter would stall
+        the teardown — then reap the fabric threads."""
+        self.stop_event.set()
+        self.telemetry.close_exporter()
+        self.supervisor.join_all(timeout=5.0)
+
+    def close(self) -> None:
+        self.telemetry.close()
+        for sig, handler in self._prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
 
 
 # --------------------------------------------------------------------------
@@ -460,11 +601,15 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                     "a cold ring", stacklevel=2)
 
     tracer = tracer or Tracer()
-    telemetry = Telemetry(cfg, checkpoint_dir)
-    supervisor = Supervisor(
-        max_restarts=3,
-        on_giveup=lambda name: telemetry.registry.inc(
-            "supervisor.gaveup", thread=name))
+    scaffold = _HostScaffold(
+        cfg, checkpoint_dir, max_wall_seconds=max_wall_seconds,
+        signal_msg="draining the anakin loop, then saving full "
+                   "on-device state",
+        watch_label="anakin loop")
+    telemetry, supervisor = scaffold.telemetry, scaffold.supervisor
+    heartbeat, stall, logs = (scaffold.heartbeat, scaffold.stall,
+                              scaffold.logs)
+    stop_event, stop = scaffold.stop_event, scaffold.stop
     chaos = None
     if cfg.chaos_spec:
         from r2d2_tpu.utils.chaos import ChaosInjector
@@ -474,34 +619,11 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
         chaos = ChaosInjector(cfg.chaos_spec, seed=cfg.seed)
         if checkpointer is not None:
             checkpointer.chaos = chaos
-    stop_event = threading.Event()
-    deadline = (time.time() + max_wall_seconds) if max_wall_seconds else None
-
-    def stop() -> bool:
-        return (stop_event.is_set() or supervisor.any_failed
-                or (deadline is not None and time.time() > deadline))
-
-    prev_handlers: Dict[int, Any] = {}
-    if threading.current_thread() is threading.main_thread():
-        def _on_signal(signum, frame):
-            log.warning("signal %d: draining the anakin loop, then saving "
-                        "full on-device state", signum)
-            stop_event.set()
-
-        for sig in (signal.SIGTERM, signal.SIGINT):
-            try:
-                prev_handlers[sig] = signal.signal(sig, _on_signal)
-            except (ValueError, OSError):
-                pass
-
-    heartbeat = Heartbeat()
-    stall = {"stalled": False}
+    scaffold.install_signals()
 
     def learner_stop() -> bool:
         heartbeat.beat()
         return stop()
-
-    logs: "collections.deque" = collections.deque(maxlen=cfg.log_history_cap)
 
     def healthz() -> Dict[str, Any]:
         age = heartbeat.age()
@@ -552,18 +674,6 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
             last_steps, last_frames, last_time = (
                 s["training_steps"], s["frames"], now)
 
-    def learner_watch():
-        poll = min(0.05, cfg.learner_stall_timeout / 4)
-        while not stop():
-            time.sleep(poll)
-            if heartbeat.age() > cfg.learner_stall_timeout:
-                stall["stalled"] = True
-                log.error("anakin loop heartbeat stale for %.1fs (budget "
-                          "%.1fs): declaring a stall and stopping",
-                          heartbeat.age(), cfg.learner_stall_timeout)
-                stop_event.set()
-                return
-
     want_full_save = checkpointer is not None and cfg.replay_snapshot
 
     def save_anakin_snapshot(step: int) -> None:
@@ -575,33 +685,19 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
         except Exception as e:  # never fail the run over snapshot I/O
             log.warning("anakin full-state snapshot failed: %s", e)
 
-    loops = [("log", log_loop)]
-    if cfg.learner_stall_timeout > 0:
-        loops.append(("learner_watch", learner_watch))
-    exporter = telemetry.serve(healthz)
-    if exporter is not None:
-        def telemetry_loop():
-            while not exporter.closed:
-                try:
-                    exporter.handle_once()
-                except (OSError, ValueError):
-                    return
-
-        loops.append(("telemetry", telemetry_loop))
+    loops = ([("log", log_loop)] + scaffold.watch_loops()
+             + scaffold.exporter_loops(healthz))
 
     try:
         try:
-            for name, loop in loops:
-                supervisor.start(name, loop)
+            scaffold.start(loops)
             with device_profile(profile_dir):
                 metrics = run_anakin_loop(
                     learner, plane, stop=learner_stop, tracer=tracer,
                     snapshot_fn=(save_anakin_snapshot if want_full_save
                                  else None), chaos=chaos)
         finally:
-            stop_event.set()
-            telemetry.close_exporter()
-            supervisor.join_all(timeout=5.0)
+            scaffold.quiesce()
 
         # drain-then-save epilogue: the learner state was saved by
         # run_anakin_loop's final _save; persist the on-device loop state
@@ -625,12 +721,7 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
             metrics["chaos"] = chaos.counts()
         return metrics
     finally:
-        telemetry.close()
-        for sig, handler in prev_handlers.items():
-            try:
-                signal.signal(sig, handler)
-            except (ValueError, OSError):
-                pass
+        scaffold.close()
 
 
 # --------------------------------------------------------------------------
@@ -717,14 +808,13 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     checkpointer = sys["checkpointer"]
     plane = sys["plane"]
     tracer = tracer or Tracer()
-    telemetry = Telemetry(cfg, checkpoint_dir)
-    # a thread exhausting its restart budget is stamped straight into the
-    # registry by the supervisor itself — the log loop (the usual
-    # absorption path) may be the very thread that died
-    supervisor = Supervisor(
-        max_restarts=max_thread_restarts,
-        on_giveup=lambda name: telemetry.registry.inc(
-            "supervisor.gaveup", thread=name))
+    scaffold = _HostScaffold(cfg, checkpoint_dir,
+                             max_wall_seconds=max_wall_seconds,
+                             max_thread_restarts=max_thread_restarts)
+    telemetry, supervisor = scaffold.telemetry, scaffold.supervisor
+    heartbeat, stall, logs = (scaffold.heartbeat, scaffold.stall,
+                              scaffold.logs)
+    stop_event, stop = scaffold.stop_event, scaffold.stop
 
     chaos = None
     if cfg.chaos_spec:
@@ -748,44 +838,17 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             plane.service.tracer = tracer
             plane.service.chaos = chaos
 
-    stop_event = threading.Event()
-    deadline = (time.time() + max_wall_seconds) if max_wall_seconds else None
-
-    def stop() -> bool:
-        return (stop_event.is_set() or supervisor.any_failed
-                or (deadline is not None and time.time() > deadline))
-
     # preemption hook: SIGTERM/SIGINT request a drain-then-save shutdown —
     # the learner exits at its next stop poll, the fabric quiesces, and
     # the epilogue below writes the full-state snapshot (learner state via
     # Learner.run's own final save; replay ring + actor state via
-    # checkpointer.save_replay).  Signals only reach the main thread;
-    # a train() driven from a worker thread (tests, sweep) skips the hook.
-    prev_handlers: Dict[int, Any] = {}
-    if threading.current_thread() is threading.main_thread():
-        def _on_signal(signum, frame):
-            log.warning("signal %d: draining fabric, then saving full "
-                        "state", signum)
-            stop_event.set()
-
-        for sig in (signal.SIGTERM, signal.SIGINT):
-            try:
-                prev_handlers[sig] = signal.signal(sig, _on_signal)
-            except (ValueError, OSError):  # exotic embedding: no signals
-                pass
+    # checkpointer.save_replay)
+    scaffold.install_signals()
 
     # full-state snapshots need the host ring (device_replay state lives
     # in HBM) and a single process (per-host snapshot dirs would collide)
     want_full_save = (checkpointer is not None and cfg.replay_snapshot
                       and sys["ring"] is None and jax.process_count() == 1)
-
-    # learner liveness: the learner beats through every stop poll (loop
-    # iterations AND queue waits), so a stale heartbeat means a genuinely
-    # frozen thread — wedged collective, dead interconnect, chaos freeze —
-    # not a slow batch.  The watchdog stops the fabric instead of letting
-    # actors feed a wedged learner forever.
-    heartbeat = Heartbeat()
-    stall = {"stalled": False}
 
     def learner_stop() -> bool:
         if chaos is not None:
@@ -828,12 +891,6 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 continue
             with tracer.span("buffer.update_priorities"):
                 buffer.update_priorities(idxes, priorities, old_ptr, loss)
-
-    # bounded ring (cfg.log_history_cap): the JSONL run log is the
-    # durable record; this is the in-memory tail metrics["logs"] returns
-    # (the old unbounded list leaked ~1 entry/interval forever in soaks)
-    logs: "collections.deque" = collections.deque(
-        maxlen=cfg.log_history_cap)
 
     def healthz() -> Dict[str, Any]:
         """The /healthz verdict — three states (docs/OBSERVABILITY.md):
@@ -905,19 +962,6 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 print(format_entry(entry), flush=True)
             last_steps, last_time = s["training_steps"], now
 
-    def learner_watch():
-        poll = min(0.05, cfg.learner_stall_timeout / 4)
-        while not stop():
-            time.sleep(poll)
-            if heartbeat.age() > cfg.learner_stall_timeout:
-                stall["stalled"] = True
-                log.error("learner heartbeat stale for %.1fs (budget "
-                          "%.1fs): declaring a stall and stopping the "
-                          "fabric", heartbeat.age(),
-                          cfg.learner_stall_timeout)
-                stop_event.set()
-                return
-
     def chaos_loop():
         # process-plane fault sites (fleet kill, slab garbling); learner
         # freeze fires from learner_stop, checkpoint truncation from the
@@ -942,8 +986,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     loops = [(f"actor{f}" if len(actors) > 1 else "actor",
               make_actor_loop(a)) for f, a in enumerate(actors)]
-    if cfg.learner_stall_timeout > 0:
-        loops.append(("learner_watch", learner_watch))
+    loops += scaffold.watch_loops()
     if chaos is not None and plane is not None and (
             chaos.enabled("kill_fleet") or chaos.enabled("garble_block")):
         loops.append(("chaos", chaos_loop))
@@ -956,19 +999,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         loops += plane.make_loops(stop, buffer.add)
     loops += [("sample", sample_loop), ("priority", priority_loop),
               ("log", log_loop)]
-    exporter = telemetry.serve(healthz)   # None when telemetry_port == 0
-    if exporter is not None:
-        def telemetry_loop():
-            # close-driven, NOT stop-driven: a stalled/stopping run must
-            # stay scrapeable (that is when /healthz matters most); the
-            # teardown below closes the exporter before joining us
-            while not exporter.closed:
-                try:
-                    exporter.handle_once()
-                except (OSError, ValueError):
-                    return            # server closed under a late poll
-
-        loops.append(("telemetry", telemetry_loop))
+    loops += scaffold.exporter_loops(healthz)
     if sys["ring"] is not None:
         # device replay: the learner samples index bundles itself (cheap,
         # coupled to its dispatch) — no host batch-staging thread
@@ -1018,8 +1049,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         try:
             if plane is not None:
                 plane.start(sys["param_store"])
-            for name, loop in loops:
-                supervisor.start(name, loop)
+            scaffold.start(loops)
             with device_profile(profile_dir):
                 if sys["ring"] is not None:
                     metrics = learner.run_device(buffer, sys["ring"],
@@ -1030,11 +1060,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                     metrics = learner.run(batch_source, priority_sink,
                                           stop=learner_stop, tracer=tracer)
         finally:
-            stop_event.set()
-            # before join_all: the telemetry loop exits on close, and a
-            # joined-but-serving exporter would stall the teardown
-            telemetry.close_exporter()
-            supervisor.join_all(timeout=5.0)
+            scaffold.quiesce()
             if plane is not None:
                 # drain-then-save: collect resumable actor snapshots from the
                 # dying fleets (answered by their shutdown handshake)
@@ -1082,9 +1108,4 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             metrics["fleet_health"] = plane.health()
         return metrics
     finally:
-        telemetry.close()
-        for sig, handler in prev_handlers.items():
-            try:
-                signal.signal(sig, handler)
-            except (ValueError, OSError):
-                pass
+        scaffold.close()

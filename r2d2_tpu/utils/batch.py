@@ -3,7 +3,7 @@
 One canonical builder for every consumer that needs a train-step batch
 without a live replay buffer: the benchmark, the multi-chip dry-run, and
 tests.  Keys must stay in sync with ``ReplayBuffer.sample_batch`` and
-``parallel.mesh.DEVICE_BATCH_KEYS``.
+``parallel.sharding.DEVICE_BATCH_KEYS``.
 """
 from __future__ import annotations
 

@@ -56,7 +56,7 @@ cfg = test_config(game_name="Fake", device_replay=DEVICE_REPLAY,
                                          # exit drain must stay deadlock-free
                   training_steps=8, log_interval=0.3, num_actors=2,
                   weight_publish_interval=2,  # force publishes mid-run
-                  mesh_shape=(("dp", 4), ("mp", 2)))
+                  mesh_shape=(("dp", 4), ("tp", 2)))
 m = train(cfg, env_factory=lambda c, s: FakeAtariEnv(
               obs_shape=c.stored_obs_shape, action_dim=4, seed=s + 31 * PID),
           use_mesh=True, verbose=False)

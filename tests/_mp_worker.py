@@ -46,7 +46,7 @@ from r2d2_tpu.parallel.mesh import make_mesh  # noqa: E402
 from r2d2_tpu.utils.batch import synthetic_batch  # noqa: E402
 
 A = 4
-cfg = test_config(batch_size=8, mesh_shape=(("dp", 4), ("mp", 2)),
+cfg = test_config(batch_size=8, mesh_shape=(("dp", 4), ("tp", 2)),
                   prefetch_batches=0)
 mesh = make_mesh(cfg)
 results["mesh_shape"] = dict(mesh.shape)
@@ -80,13 +80,15 @@ mine = local_rows(gb["last_reward"])
 results["local_rows_values"] = sorted(set(float(v) for v in mine[:, 0]))
 
 # --- sharded train steps (cross-host psum under GSPMD) -------------------
-from r2d2_tpu.parallel.mesh import replicate_state, sharded_train_step  # noqa: E402
+from r2d2_tpu.parallel.sharding import ShardingTable, pjit_train_step  # noqa: E402
 
 net = create_network(cfg, A)
 params = init_params(cfg, net, jax.random.PRNGKey(0))
 state = create_train_state(cfg, params)
-step_fn = sharded_train_step(cfg, net, mesh, state_template=state)
-state = replicate_state(mesh, state)
+table = ShardingTable(mesh, cfg)
+step_fn = pjit_train_step(cfg, net, table, state_template=state,
+                          donate_batch=False)  # gb is re-stepped below
+state = table.place_state(state)
 
 for _ in range(2):
     state, loss, priorities = step_fn(state, gb)
@@ -160,12 +162,13 @@ from r2d2_tpu.replay.block import LocalBuffer  # noqa: E402
 from r2d2_tpu.replay.device_ring import DeviceRing  # noqa: E402
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer  # noqa: E402
 
-cfg3 = test_config(batch_size=8, mesh_shape=(("dp", 4), ("mp", 2)),
+cfg3 = test_config(batch_size=8, mesh_shape=(("dp", 4), ("tp", 2)),
                    device_replay=True, superstep_k=2, prefetch_batches=0)
 lmesh = local_mesh(mesh)
 results["local_mesh_shape"] = dict(lmesh.shape)
 
-ring = DeviceRing(cfg3, A, mesh=lmesh, layout="dp")
+ring = DeviceRing(cfg3, A, table=ShardingTable(lmesh, cfg3),
+                  layout="dp")
 buf = ReplayBuffer(cfg3, A, rng=np.random.default_rng(100 + PID),
                    device_ring=ring)
 results["ring_groups"] = ring.num_groups

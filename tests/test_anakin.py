@@ -210,6 +210,41 @@ def test_anakin_blocks_match_local_buffer_oracle(mode):
                                    rtol=0, atol=2e-5)
 
 
+def test_anakin_cut_cond_fast_path_bit_exact():
+    """The r9 lax.cond fast path (skip block emit/retention gathers on
+    no-cut steps — the (block_length-1)/block_length majority) must be
+    BIT-EXACT vs the always-emit variant across a trajectory containing
+    both boundary and episode-end cuts: identical final actor state,
+    ring arrays, PER state, and per-step traces."""
+    cfg = anakin_config(num_actors=3, anakin_episode_len=13,
+                        buffer_capacity=30 * 8)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    env = AnakinFakeEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                        episode_len=cfg.anakin_episode_len,
+                        num_lanes=cfg.num_actors)
+    T = 40
+    outs = []
+    for cut_cond in (True, False):
+        ring = DeviceRing(cfg, A)
+        ast = make_anakin_state(cfg, A, env, jax.random.PRNGKey(11))
+        meta0 = ring.per_meta()
+        carry, tr = make_debug_rollout(cfg, net, env, A, T,
+                                       cut_cond=cut_cond)(
+            params, ast, ring.snapshot(), ring.take_prios(),
+            meta0["seq_meta"], meta0["first"])
+        outs.append(jax.device_get((carry, tr)))
+    fast, slow = outs
+    # the trajectory must actually exercise both cut sites
+    assert np.asarray(slow[1]["pending"]).any()
+    assert np.asarray(slow[1]["truncated"]).any()
+    flat_f, tdef_f = jax.tree_util.tree_flatten(fast)
+    flat_s, tdef_s = jax.tree_util.tree_flatten(slow)
+    assert tdef_f == tdef_s
+    for a, b in zip(flat_f, flat_s):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 # ------------------------------------------------- host-freedom guarantees
 
 def test_anakin_host_transfers_constant_per_superstep():

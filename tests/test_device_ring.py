@@ -11,9 +11,11 @@ import pytest
 
 from r2d2_tpu.config import test_config as make_test_config
 from r2d2_tpu.envs.fake import FakeAtariEnv
-from r2d2_tpu.learner.step import (
-    create_train_state, jit_train_step, make_super_step)
+from r2d2_tpu.learner.step import create_train_state
 from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.parallel.mesh import trivial_mesh
+from r2d2_tpu.parallel.sharding import (
+    ShardingTable, pjit_super_step, pjit_train_step)
 from r2d2_tpu.replay.device_ring import DeviceRing, gather_batch
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer, data_bytes
 from r2d2_tpu.replay.block import LocalBuffer
@@ -23,6 +25,13 @@ A = 4
 
 def make_cfg(**kw):
     return make_test_config(**kw)
+
+
+def single_super_step(cfg, net, k, state):
+    """The unified super-step on a trivial 1-device mesh — the
+    single-device oracle of the same (only) entry point."""
+    return pjit_super_step(cfg, net, ShardingTable(trivial_mesh(), cfg), k,
+                           state_template=state)
 
 
 def scripted_blocks(cfg, n_blocks, seed=0):
@@ -125,7 +134,7 @@ def test_super_step_equals_sequential_steps():
 
     # sequential host-path reference trajectory on the same indices
     state_a = create_train_state(cfg, params)
-    step = jit_train_step(cfg, net)
+    step = pjit_train_step(cfg, net, state_template=state_a)
     seq_losses, seq_prios = [], []
     for j in range(k):
         batch = host.sample_batch(cfg.batch_size)
@@ -137,7 +146,7 @@ def test_super_step_equals_sequential_steps():
         seq_prios.append(np.asarray(prios))
 
     state_b = create_train_state(cfg, params)
-    super_fn = make_super_step(cfg, net, k)
+    super_fn = single_super_step(cfg, net, k, state_b)
     state_b, losses, prios = super_fn(state_b, ring.snapshot(),
                                       jnp.asarray(meta["ints"]),
                                       jnp.asarray(meta["is_weights"]))
@@ -177,10 +186,9 @@ def test_sharded_super_step_matches_single_device():
     """The mesh-compiled super-step (replicated ring, dp-sharded index
     bundles, GSPMD grad psums) must reproduce the single-device super-step
     trajectory."""
-    from r2d2_tpu.parallel.mesh import (
-        make_mesh, replicate_state, replicated, sharded_super_step)
+    from r2d2_tpu.parallel.mesh import make_mesh
 
-    cfg = make_cfg(mesh_shape=(("dp", 4), ("mp", 2)))
+    cfg = make_cfg(mesh_shape=(("dp", 4), ("tp", 2)))
     k = 2
     _, dev, ring = paired_buffers(cfg, n_blocks=4)
     net = create_network(cfg, A)
@@ -188,19 +196,19 @@ def test_sharded_super_step_matches_single_device():
     meta = dev.sample_meta(k=k, batch_size=cfg.batch_size)
 
     state_a = create_train_state(cfg, params)
-    super_a = make_super_step(cfg, net, k)
+    super_a = single_super_step(cfg, net, k, state_a)
     state_a, losses_a, prios_a = super_a(state_a, ring.snapshot(),
                                          jnp.asarray(meta["ints"]),
                                          jnp.asarray(meta["is_weights"]))
 
-    mesh = make_mesh(cfg)
+    table = ShardingTable(make_mesh(cfg), cfg)
     # mesh-replicated ring holding the same data
-    ring_b = DeviceRing(cfg, A, placement=replicated(mesh))
-    ring_b.arrays = {kk: jax.device_put(np.asarray(v), replicated(mesh))
+    ring_b = DeviceRing(cfg, A, placement=table.replicated())
+    ring_b.arrays = {kk: jax.device_put(np.asarray(v), table.replicated())
                      for kk, v in ring.snapshot().items()}
     state_b = create_train_state(cfg, params)
-    super_b = sharded_super_step(cfg, net, mesh, k, state_template=state_b)
-    state_b = replicate_state(mesh, state_b)
+    super_b = pjit_super_step(cfg, net, table, k, state_template=state_b)
+    state_b = table.place_state(state_b)
     state_b, losses_b, prios_b = super_b(state_b, ring_b.snapshot(),
                                          jnp.asarray(meta["ints"]),
                                          jnp.asarray(meta["is_weights"]))
@@ -238,7 +246,7 @@ def test_train_end_to_end_device_replay_under_mesh():
 # ---------------------------------------------------------------------------
 
 def dp_buffers(cfg, mesh, n_blocks, seed=0, layout="dp"):
-    ring = DeviceRing(cfg, A, mesh=mesh, layout=layout)
+    ring = DeviceRing(cfg, A, table=ShardingTable(mesh, cfg), layout=layout)
     buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(99),
                        device_ring=ring)
     for blk, prios in scripted_blocks(cfg, n_blocks, seed):
@@ -268,8 +276,8 @@ def test_dp_ring_round_robin_fill():
 
 def test_dp_sample_meta_rows_stay_in_own_group():
     """Row chunk g of every sampled bundle must reference only group g's
-    slot slab — the precondition for the collective-free shard_map
-    gather."""
+    slot slab — what keeps GSPMD's partitioned gather local in practice
+    (no cross-slab batch traffic under the table's ring.* dp layout)."""
     from r2d2_tpu.parallel.mesh import make_mesh
 
     cfg = make_cfg(mesh_shape=(("dp", 4),))
@@ -297,13 +305,12 @@ def test_dp_sample_meta_rejects_indivisible_batch():
 
 @pytest.mark.slow
 def test_dp_sharded_super_step_matches_single_device():
-    """The dp-sharded data plane (slot-sharded ring, shard_map gather) must
-    reproduce the single-device super-step on the same index bundles —
-    only the byte placement changes, never the math."""
-    from r2d2_tpu.parallel.mesh import (
-        make_mesh, replicate_state, sharded_super_step)
+    """The dp-sharded data plane (slot-sharded ring, GSPMD-partitioned
+    gather) must reproduce the single-device super-step on the same index
+    bundles — only the byte placement changes, never the math."""
+    from r2d2_tpu.parallel.mesh import make_mesh
 
-    cfg = make_cfg(mesh_shape=(("dp", 4), ("mp", 2)))
+    cfg = make_cfg(mesh_shape=(("dp", 4), ("tp", 2)))
     mesh = make_mesh(cfg)
     k = 2
     buf, ring = dp_buffers(cfg, mesh, n_blocks=6)
@@ -315,15 +322,16 @@ def test_dp_sharded_super_step_matches_single_device():
     arrays_host = {kk: np.asarray(jax.device_get(v))
                    for kk, v in ring.snapshot().items()}
     state_a = create_train_state(cfg, params)
-    super_a = make_super_step(cfg, net, k)
+    super_a = single_super_step(cfg, net, k, state_a)
     state_a, losses_a, prios_a = super_a(
         state_a, {kk: jnp.asarray(v) for kk, v in arrays_host.items()},
         jnp.asarray(meta["ints"]), jnp.asarray(meta["is_weights"]))
 
+    table = ShardingTable(mesh, cfg)
     state_b = create_train_state(cfg, params)
-    super_b = sharded_super_step(cfg, net, mesh, k,
-                                 state_template=state_b, layout="dp")
-    state_b = replicate_state(mesh, state_b)
+    super_b = pjit_super_step(cfg, net, table, k,
+                              state_template=state_b, layout="dp")
+    state_b = table.place_state(state_b)
     state_b, losses_b, prios_b = super_b(state_b, ring.snapshot(),
                                          jnp.asarray(meta["ints"]),
                                          jnp.asarray(meta["is_weights"]))
@@ -380,7 +388,7 @@ def test_dp_is_weights_use_per_group_densities():
 
     cfg = make_cfg(mesh_shape=(("dp", 2),))
     mesh = make_mesh(cfg)
-    ring = DeviceRing(cfg, A, mesh=mesh, layout="dp")
+    ring = DeviceRing(cfg, A, table=ShardingTable(mesh, cfg), layout="dp")
     buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(3),
                        device_ring=ring)
     blocks = scripted_blocks(cfg, 2)
@@ -466,7 +474,8 @@ def test_resolve_layout():
         resolve_layout(cfg.replace(device_ring_layout="dp"), None,
                        GB, 16 * GB)
     # auto + in_graph_per: shards exactly like the host-PER ring — the
-    # grouped in-graph sampler handles dp slabs (parallel/mesh.py)
+    # global in-graph sampler reads dp slabs through GSPMD
+    # (parallel/sharding.py)
     cfg_ig = make_cfg(mesh_shape=(("dp", 4),), device_replay=True,
                       in_graph_per=True)
     assert resolve_layout(cfg_ig, mesh, 15 * GB, 16 * GB) == "dp"

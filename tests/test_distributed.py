@@ -14,9 +14,10 @@ from r2d2_tpu.parallel.distributed import (
     local_rows,
     sync_counter,
 )
-from r2d2_tpu.parallel.mesh import (
+from r2d2_tpu.parallel.mesh import make_mesh
+from r2d2_tpu.parallel.sharding import (
     DEVICE_BATCH_KEYS,
-    make_mesh,
+    ShardingTable,
     shard_batch,
 )
 from r2d2_tpu.utils.batch import synthetic_batch
@@ -44,7 +45,7 @@ def test_host_local_batch_matches_device_put(mesh):
     local = {k: batch[k] for k in DEVICE_BATCH_KEYS}
 
     global_arrays = host_local_batch(mesh, local)
-    reference = shard_batch(mesh, batch)
+    reference = shard_batch(ShardingTable(mesh, cfg), batch)
     for k in DEVICE_BATCH_KEYS:
         np.testing.assert_array_equal(
             np.asarray(jax.device_get(global_arrays[k])),
@@ -57,13 +58,15 @@ def test_host_local_batch_feeds_sharded_step(mesh):
     train step (end-to-end device-batch path of a multi-host learner)."""
     from r2d2_tpu.learner.step import create_train_state
     from r2d2_tpu.models.network import create_network, init_params
-    from r2d2_tpu.parallel.mesh import replicate_state, sharded_train_step
+    from r2d2_tpu.parallel.sharding import pjit_train_step
 
     cfg = make_test_config(mesh_shape=(("dp", 4),), batch_size=8)
     net = create_network(cfg, 4)
     params = init_params(cfg, net, jax.random.PRNGKey(0))
-    state = replicate_state(mesh, create_train_state(cfg, params))
-    step = sharded_train_step(cfg, net, mesh)
+    table = ShardingTable(mesh, cfg)
+    state0 = create_train_state(cfg, params)
+    state = table.place_state(state0)
+    step = pjit_train_step(cfg, net, table, state_template=state0)
 
     rng = np.random.default_rng(1)
     batch = synthetic_batch(cfg, 4, rng)
@@ -92,9 +95,9 @@ def test_local_rows_roundtrip_dp_sharded(mesh):
 
 
 def test_local_rows_dedups_replicated_axis():
-    """With a 2-D (dp, mp) mesh, each dp row-shard is replicated across mp
+    """With a 2-D (dp, tp) mesh, each dp row-shard is replicated across tp
     devices; local_rows must return each row range exactly once."""
-    cfg = make_test_config(mesh_shape=(("dp", 2), ("mp", 2)))
+    cfg = make_test_config(mesh_shape=(("dp", 2), ("tp", 2)))
     m = make_mesh(cfg)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -104,7 +107,8 @@ def test_local_rows_dedups_replicated_axis():
 
 
 def test_dp_rows_with_trailing_dp_axis():
-    """dp need not be the leading mesh axis."""
-    cfg = make_test_config(mesh_shape=(("mp", 2), ("dp", 2)))
+    """dp need not be the leading mesh axis of the CONFIG spec (the
+    canonical mesh still orders axes dp, fsdp, tp)."""
+    cfg = make_test_config(mesh_shape=(("tp", 2), ("dp", 2)))
     m = make_mesh(cfg)
     assert dp_rows_for_process(m, 8) == slice(0, 8)

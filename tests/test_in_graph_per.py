@@ -13,10 +13,11 @@ import numpy as np
 import pytest
 
 from r2d2_tpu.config import test_config as make_test_config
-from r2d2_tpu.learner.step import (
-    _in_graph_sample, create_train_state, make_in_graph_per_super_step,
-)
+from r2d2_tpu.learner.step import _in_graph_sample, create_train_state
 from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.parallel.mesh import trivial_mesh
+from r2d2_tpu.parallel.sharding import (
+    ShardingTable, pjit_in_graph_per_super_step)
 from r2d2_tpu.replay.block import LocalBuffer
 from r2d2_tpu.replay.device_ring import DeviceRing
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
@@ -27,6 +28,14 @@ A = 4
 
 def make_cfg(**kw):
     return make_test_config(device_replay=True, in_graph_per=True, **kw)
+
+
+def ig_step(cfg, net, k, state):
+    """The unified device-PER super-step on a trivial 1-device mesh — the
+    single-device oracle of the same (only) entry point."""
+    return pjit_in_graph_per_super_step(
+        cfg, net, ShardingTable(trivial_mesh(), cfg), k,
+        state_template=state)
 
 
 def scripted_blocks(cfg, n_blocks, seed=0):
@@ -164,7 +173,7 @@ def test_in_graph_super_step_trains_and_scatters_feedback():
     p0 = np.asarray(ring.take_prios())
     meta = ring.per_meta()
     step0 = int(state.step)
-    fn = make_in_graph_per_super_step(cfg, net, 2)
+    fn = ig_step(cfg, net, 2, state)
     state2, new_prios, losses = fn(state, ring.snapshot(),
                                    ring.take_prios(), meta["seq_meta"],
                                    meta["first"], jnp.asarray(7, jnp.uint32))
@@ -187,7 +196,7 @@ def test_in_graph_scatter_writes_host_equivalent_priorities():
     step computes for the same sampled batch.  Cross-checked by
     replaying the (deterministic) stratified draw on the host and
     running the plain train step on the identically gathered batch."""
-    from r2d2_tpu.learner.step import jit_train_step
+    from r2d2_tpu.parallel.sharding import pjit_train_step
     from r2d2_tpu.replay.device_ring import gather_batch
 
     cfg = make_cfg(superstep_k=1)
@@ -209,14 +218,15 @@ def test_in_graph_scatter_writes_host_equivalent_priorities():
     # plain train step on the identically gathered batch
     batch = gather_batch(cfg, ring.snapshot(), jnp.asarray(ints),
                          jnp.asarray(w))
-    _, _, prios_ref = jit_train_step(cfg, net)(state, batch)
+    _, _, prios_ref = pjit_train_step(cfg, net, state_template=state)(
+        state, batch)
 
     # the in-graph super-step (fresh state: the first one was donated;
     # snapshot p0 to host BEFORE the call donates it)
     p0_np = np.asarray(p0).copy()
     state2 = create_train_state(cfg, init_params(cfg, net,
                                                  jax.random.PRNGKey(0)))
-    fn = make_in_graph_per_super_step(cfg, net, 1)
+    fn = ig_step(cfg, net, 1, state2)
     _, new_prios, _ = fn(state2, ring.snapshot(), p0, meta["seq_meta"],
                          meta["first"], dispatch_idx)
 
@@ -231,9 +241,7 @@ def test_in_graph_per_sharded_matches_single_device():
     """dp=8 mesh device-PER super-step == single-device: same losses,
     same scattered priorities, same params (sampling is deterministic
     given the fold_in key, so the mesh run draws identical strata)."""
-    from r2d2_tpu.parallel.mesh import (
-        make_mesh, replicate_state, sharded_in_graph_per_super_step,
-    )
+    from r2d2_tpu.parallel.mesh import make_mesh
 
     cfg = make_cfg(superstep_k=2)
     buf, ring = filled(cfg, n_blocks=3)
@@ -243,14 +251,17 @@ def test_in_graph_per_sharded_matches_single_device():
     p_start = np.asarray(ring.take_prios())
     idx7 = jnp.asarray(7, jnp.uint32)
 
-    s1, p1, l1 = make_in_graph_per_super_step(cfg, net, 2)(
-        create_train_state(cfg, params), ring.snapshot(),
+    s0 = create_train_state(cfg, params)
+    s1, p1, l1 = ig_step(cfg, net, 2, s0)(
+        s0, ring.snapshot(),
         jnp.asarray(p_start), meta["seq_meta"], meta["first"], idx7)
 
-    mesh = make_mesh(cfg)
-    stepN = sharded_in_graph_per_super_step(cfg, net, mesh, 2)
+    table = ShardingTable(make_mesh(cfg), cfg)
+    sN0 = create_train_state(cfg, params)
+    stepN = pjit_in_graph_per_super_step(cfg, net, table, 2,
+                                         state_template=sN0)
     sN, pN, lN = stepN(
-        replicate_state(mesh, create_train_state(cfg, params)),
+        table.place_state(sN0),
         ring.snapshot(), jnp.asarray(p_start), meta["seq_meta"],
         meta["first"], idx7)
 
@@ -362,7 +373,7 @@ def dp_filled(cfg, n_blocks=8, seed=0):
     from r2d2_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(cfg)
-    ring = DeviceRing(cfg, A, mesh=mesh, layout="dp")
+    ring = DeviceRing(cfg, A, table=ShardingTable(mesh, cfg), layout="dp")
     buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(99),
                        device_ring=ring)
     for blk, prios in scripted_blocks(cfg, n_blocks, seed):
@@ -378,7 +389,7 @@ def test_in_graph_sample_raw_matches_host_per_slab():
     _grouped_densities contract (replay_buffer.py)."""
     from r2d2_tpu.learner.step import _in_graph_sample_raw
 
-    cfg = make_cfg(mesh_shape=(("dp", 4), ("mp", 2)),
+    cfg = make_cfg(mesh_shape=(("dp", 4), ("tp", 2)),
                    device_ring_layout="dp")
     K, L = cfg.seqs_per_block, cfg.learning_steps
     mesh, buf, ring = dp_filled(cfg)
@@ -413,23 +424,21 @@ def test_in_graph_sample_raw_matches_host_per_slab():
 
 @pytest.mark.slow
 def test_in_graph_per_dp_super_step_trains_and_guards_padding():
-    """The dp-layout device-PER super-step (per-slab shard_map sampling,
-    parallel/mesh.py): finite losses, params advance, and the priority
+    """The dp-layout device-PER super-step (the SAME table-driven pjit
+    step — PER leaves shard with the ring slabs, the stratified draw is
+    global under GSPMD): finite losses, params advance, and the priority
     scatter can only touch positive leaves — zero (padding / empty-slot)
     leaves stay exactly zero, so padding never becomes sampleable."""
-    from r2d2_tpu.parallel.mesh import (
-        replicate_state, sharded_in_graph_per_super_step,
-    )
-
-    cfg = make_cfg(superstep_k=2, mesh_shape=(("dp", 4), ("mp", 2)),
+    cfg = make_cfg(superstep_k=2, mesh_shape=(("dp", 4), ("tp", 2)),
                    device_ring_layout="dp")
     mesh, buf, ring = dp_filled(cfg, n_blocks=6)  # some slots stay empty
     net = create_network(cfg, A)
     params = init_params(cfg, net, jax.random.PRNGKey(0))
-    state = replicate_state(mesh, create_train_state(cfg, params))
-    step = sharded_in_graph_per_super_step(
-        cfg, net, mesh, 2, state_template=state, layout="dp",
-        blocks_per_group=ring.blocks_per_group)
+    table = ShardingTable(mesh, cfg)
+    state0 = create_train_state(cfg, params)
+    state = table.place_state(state0)
+    step = pjit_in_graph_per_super_step(
+        cfg, net, table, 2, state_template=state0, layout="dp")
 
     p_before = np.asarray(ring.take_prios())
     params_before = [np.asarray(x) for x in jax.tree.leaves(state.params)]
@@ -450,17 +459,58 @@ def test_in_graph_per_dp_super_step_trains_and_guards_padding():
     assert moved
 
 
+def test_in_graph_per_dp_layout_matches_single_device():
+    """The dp-sharded layout is a pure layout choice: over the SAME
+    global ring content, the dp=4-sharded run of the (only) entry point
+    and a single-device trivial-mesh run draw identical strata and agree
+    on losses, scattered priorities, and params at reduction-order
+    round-off.  (Block→slab ROUTING does depend on the dp size — rings
+    filled under different dp hold the same blocks in permuted global
+    slots — so the invariant is content-for-content, not
+    fill-for-fill.)"""
+    cfg = make_cfg(superstep_k=2, mesh_shape=(("dp", 4), ("tp", 2)),
+                   device_ring_layout="dp")
+    mesh, buf, ring = dp_filled(cfg, n_blocks=6)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    meta = ring.per_meta()
+    p_start = np.asarray(ring.take_prios())
+    snap_host = jax.device_get(ring.snapshot())
+    seq_meta = np.asarray(meta["seq_meta"])
+    first = np.asarray(meta["first"])
+    idx5 = jnp.asarray(5, jnp.uint32)
+
+    s0 = create_train_state(cfg, params)
+    s1, p1, l1 = ig_step(cfg, net, 2, s0)(
+        s0, snap_host, jnp.asarray(p_start), seq_meta, first, idx5)
+
+    table = ShardingTable(mesh, cfg)
+    sN0 = create_train_state(cfg, params)
+    stepN = pjit_in_graph_per_super_step(
+        cfg, net, table, 2, state_template=sN0, layout="dp")
+    sN, pN, lN = stepN(
+        table.place_state(sN0), ring.snapshot(), ring.take_prios(),
+        meta["seq_meta"], meta["first"], idx5)
+
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(lN), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(pN),
+                               rtol=1e-4, atol=1e-7)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(sN.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
 @pytest.mark.slow
 def test_train_end_to_end_in_graph_per_dp_layout():
     """Full threaded fabric: device PER over a dp-sharded ring on a
-    dp=4 x mp=2 mesh — the capacity-scaling composition (pod-size
+    dp=4 x tp=2 mesh — the capacity-scaling composition (pod-size
     replay + zero-host-round-trip priorities) the round-4 guard
     forbade."""
     from r2d2_tpu.train import train
 
     cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=8,
                    device_ring_layout="dp", log_interval=0.2,
-                   mesh_shape=(("dp", 4), ("mp", 2)))
+                   mesh_shape=(("dp", 4), ("tp", 2)))
     metrics = train(
         cfg,
         env_factory=lambda c, seed: FakeAtariEnv(
@@ -546,13 +596,13 @@ def test_train_sync_accepts_in_graph_preset():
 @pytest.mark.slow
 def test_train_end_to_end_in_graph_per_dp_fused():
     """The full composition stack at once: dp-sharded ring + device PER
-    + fused double unroll on a dp=4 x mp=2 mesh — every r4/r5 throughput
+    + fused double unroll on a dp=4 x tp=2 mesh — every r4/r5 throughput
     feature live in one fabric."""
     from r2d2_tpu.train import train
 
     cfg = make_cfg(game_name="Fake", superstep_k=2, training_steps=8,
                    device_ring_layout="dp", fused_double_unroll=True,
-                   log_interval=0.2, mesh_shape=(("dp", 4), ("mp", 2)))
+                   log_interval=0.2, mesh_shape=(("dp", 4), ("tp", 2)))
     metrics = train(
         cfg,
         env_factory=lambda c, seed: FakeAtariEnv(

@@ -5,10 +5,11 @@ import pytest
 
 from r2d2_tpu.config import test_config as make_test_config
 from r2d2_tpu.learner.step import (
-    TrainState, create_train_state, jit_train_step, loss_and_priorities,
+    TrainState, create_train_state, loss_and_priorities,
     _window_indices, value_rescale, inverse_value_rescale,
 )
 from r2d2_tpu.models.network import R2D2Network, create_network, init_params
+from r2d2_tpu.parallel.sharding import pjit_train_step
 from r2d2_tpu.utils import math as hmath
 
 A = 4
@@ -167,9 +168,12 @@ def test_train_step_reduces_loss_and_syncs_target():
     net = create_network(cfg, A)
     params = init_params(cfg, net, jax.random.PRNGKey(2))
     state = create_train_state(cfg, params)
-    step_fn = jit_train_step(cfg, net)
+    # the ONE train-step entry point (trivial 1-device mesh); host numpy
+    # batches — the step donates its batch arg, so a device batch could
+    # not be re-stepped
+    step_fn = pjit_train_step(cfg, net, state_template=state)
     rng = np.random.default_rng(8)
-    batch = {k: jnp.asarray(v) for k, v in make_batch(cfg, rng, B=8).items()}
+    batch = make_batch(cfg, rng, B=8)
 
     losses = []
     for i in range(10):

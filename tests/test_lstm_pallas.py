@@ -74,8 +74,9 @@ def test_network_pallas_matches_scan_end_to_end():
     pallas config matches the scan config exactly — make_train_step
     must route every grad path through the scan loss net (_loss_net)."""
     from r2d2_tpu.config import test_config
-    from r2d2_tpu.learner.step import create_train_state, jit_train_step
+    from r2d2_tpu.learner.step import create_train_state
     from r2d2_tpu.models.network import R2D2Network, create_network, init_params
+    from r2d2_tpu.parallel.sharding import pjit_train_step
     from r2d2_tpu.utils.batch import synthetic_batch
 
     cfg_scan = test_config(lstm_impl="scan", lstm_layers=2)
@@ -99,12 +100,15 @@ def test_network_pallas_matches_scan_end_to_end():
     np.testing.assert_allclose(hid_p, hid_s, rtol=1e-4, atol=1e-4)
 
     # the grad path: a train step from the pallas config must equal the
-    # scan config's step bit-for-bit (both run the scan loss net)
-    dev_b = {k: jnp.asarray(v) for k, v in b.items()}
-    st_s, loss_s, pr_s = jit_train_step(cfg_scan, net_s)(
-        create_train_state(cfg_scan, params), dev_b)
-    st_p, loss_p, pr_p = jit_train_step(cfg_pl, net_p)(
-        create_train_state(cfg_pl, params), dev_b)
+    # scan config's step bit-for-bit (both run the scan loss net).  Host
+    # batches: the unified step donates its batch arg, so one device
+    # batch could not feed both steps.
+    st0_s = create_train_state(cfg_scan, params)
+    st_s, loss_s, pr_s = pjit_train_step(
+        cfg_scan, net_s, state_template=st0_s)(st0_s, dict(b))
+    st0_p = create_train_state(cfg_pl, params)
+    st_p, loss_p, pr_p = pjit_train_step(
+        cfg_pl, net_p, state_template=st0_p)(st0_p, dict(b))
     np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(pr_p), np.asarray(pr_s),
                                rtol=1e-6)

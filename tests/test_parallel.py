@@ -1,20 +1,25 @@
 """Multi-device learner tests on the virtual 8-device CPU mesh.
 
 The conftest forces ``--xla_force_host_platform_device_count=8``, so the
-GSPMD-sharded train step executes real collectives here (SURVEY.md §4).
+table-driven pjit train step (parallel/sharding.py) executes real
+collectives here (SURVEY.md §4).  Layout parity holds at reduction-order
+round-off: partitioning a batch reassociates the gradient sums (partial
+dots + psum vs one full dot), so params match to f32 ulps, not bits —
+bit-exactness across runs of the SAME layout is pinned in
+tests/test_sharding.py.
 """
 import jax
 import numpy as np
 import pytest
 
 from r2d2_tpu.config import test_config as make_test_config
-from r2d2_tpu.learner.step import create_train_state, jit_train_step
+from r2d2_tpu.learner.step import create_train_state
 from r2d2_tpu.models.network import create_network, init_params
-from r2d2_tpu.parallel.mesh import (
-    make_mesh,
-    replicate_state,
+from r2d2_tpu.parallel.mesh import make_mesh, trivial_mesh
+from r2d2_tpu.parallel.sharding import (
+    ShardingTable,
+    pjit_train_step,
     shard_batch,
-    sharded_train_step,
 )
 
 A = 4
@@ -38,6 +43,15 @@ def make_batch(cfg, rng):
     )
 
 
+def single_device_step(cfg, net, params):
+    """The SAME entry point on a trivial 1-device mesh — the unified
+    step's degenerate case, used as the semantics oracle."""
+    state = create_train_state(cfg, params)
+    table = ShardingTable(trivial_mesh(), cfg)
+    return pjit_train_step(cfg, net, table, state_template=state), \
+        table.place_state(state)
+
+
 def test_eight_virtual_devices_present():
     assert len(jax.devices()) == 8
 
@@ -45,18 +59,27 @@ def test_eight_virtual_devices_present():
 def test_make_mesh_default_spans_all_devices():
     cfg = make_test_config()
     mesh = make_mesh(cfg)
-    assert mesh.shape == {"dp": 8}
+    assert mesh.shape == {"dp": 8, "fsdp": 1, "tp": 1}
 
 
 def test_make_mesh_custom_shape_and_errors():
     cfg = make_test_config(mesh_shape=(("dp", 4),))
-    assert make_mesh(cfg).shape == {"dp": 4}
+    assert make_mesh(cfg).shape == {"dp": 4, "fsdp": 1, "tp": 1}
     with pytest.raises(ValueError, match="devices"):
         make_mesh(make_test_config(mesh_shape=(("dp", 16),)))
     with pytest.raises(ValueError, match="divisible"):
-        net = create_network(make_test_config(batch_size=6), A)
-        sharded_train_step(make_test_config(batch_size=6), net,
-                           make_mesh(make_test_config()))
+        cfg6 = make_test_config(batch_size=6)
+        net = create_network(cfg6, A)
+        state = create_train_state(cfg6, init_params(
+            cfg6, net, jax.random.PRNGKey(0)))
+        pjit_train_step(cfg6, net, ShardingTable(
+            make_mesh(make_test_config()), cfg6), state_template=state)
+
+
+def test_mp_axis_rejected():
+    """The r8-era 'mp' axis is gone; config validation names the fold."""
+    with pytest.raises(ValueError, match="folded into 'tp'"):
+        make_test_config(mesh_shape=(("dp", 4), ("mp", 2)))
 
 
 @pytest.mark.slow
@@ -69,14 +92,15 @@ def test_sharded_step_matches_single_device():
     params = init_params(cfg, net, jax.random.PRNGKey(0))
     batch = make_batch(cfg, np.random.default_rng(0))
 
-    step1 = jit_train_step(cfg, net)
-    s1, loss1, prio1 = step1(create_train_state(cfg, params),
-                             jax.tree.map(jax.numpy.asarray, batch))
+    step1, s0 = single_device_step(cfg, net, params)
+    s1, loss1, prio1 = step1(s0, dict(batch))
 
     mesh = make_mesh(cfg)
-    stepN = sharded_train_step(cfg, net, mesh)
-    sN, lossN, prioN = stepN(replicate_state(mesh, create_train_state(cfg, params)),
-                             shard_batch(mesh, batch))
+    table = ShardingTable(mesh, cfg)
+    stateN = create_train_state(cfg, params)
+    stepN = pjit_train_step(cfg, net, table, state_template=stateN)
+    sN, lossN, prioN = stepN(table.place_state(stateN),
+                             shard_batch(table, batch))
 
     assert float(loss1) == pytest.approx(float(lossN), rel=1e-5)
     np.testing.assert_allclose(np.asarray(prio1), np.asarray(prioN),
@@ -96,19 +120,19 @@ def test_fused_double_unroll_sharded_matches_single_device():
     params = init_params(cfg, net, jax.random.PRNGKey(0))
     batch = make_batch(cfg, np.random.default_rng(3))
 
-    s1, loss1, prio1 = jit_train_step(cfg, net)(
-        create_train_state(cfg, params),
-        jax.tree.map(jax.numpy.asarray, batch))
-    s0, loss0, _ = jit_train_step(cfg.replace(fused_double_unroll=False),
-                                  net)(create_train_state(cfg, params),
-                                       jax.tree.map(jax.numpy.asarray,
-                                                    batch))
+    step1, s10 = single_device_step(cfg, net, params)
+    s1, loss1, prio1 = step1(s10, dict(batch))
+    step0, s00 = single_device_step(
+        cfg.replace(fused_double_unroll=False), net, params)
+    s0, loss0, _ = step0(s00, dict(batch))
     assert float(loss0) == pytest.approx(float(loss1), rel=1e-5)
 
     mesh = make_mesh(cfg)
-    sN, lossN, prioN = sharded_train_step(cfg, net, mesh)(
-        replicate_state(mesh, create_train_state(cfg, params)),
-        shard_batch(mesh, batch))
+    table = ShardingTable(mesh, cfg)
+    stateN = create_train_state(cfg, params)
+    sN, lossN, prioN = pjit_train_step(
+        cfg, net, table, state_template=stateN)(
+        table.place_state(stateN), shard_batch(table, batch))
     assert float(loss1) == pytest.approx(float(lossN), rel=1e-5)
     np.testing.assert_allclose(np.asarray(prio1), np.asarray(prioN),
                                rtol=1e-4, atol=1e-6)
@@ -128,18 +152,19 @@ def test_sharded_multistep_stays_in_sync():
     rng = np.random.default_rng(1)
     batches = [make_batch(cfg, rng) for _ in range(3)]
 
-    step1 = jit_train_step(cfg, net)
-    s1 = create_train_state(cfg, params)
+    step1, s1 = single_device_step(cfg, net, params)
     for b in batches:
-        s1, loss1, _ = step1(s1, jax.tree.map(jax.numpy.asarray, b))
+        s1, loss1, _ = step1(s1, dict(b))
 
     mesh = make_mesh(cfg)
-    stepN = sharded_train_step(cfg, net, mesh)
-    sN = replicate_state(mesh, create_train_state(cfg, params))
+    table = ShardingTable(mesh, cfg)
+    stateN = create_train_state(cfg, params)
+    stepN = pjit_train_step(cfg, net, table, state_template=stateN)
+    sN = table.place_state(stateN)
     for b in batches:
-        sN, lossN, _ = stepN(sN, shard_batch(mesh, b))
+        sN, lossN, _ = stepN(sN, shard_batch(table, b))
 
-    assert int(s1.step) == int(sN.step) == 3
+    assert int(jax.device_get(s1.step)) == int(jax.device_get(sN.step)) == 3
     for p1, pN in zip(jax.tree.leaves(s1.target_params),
                       jax.tree.leaves(sN.target_params)):
         np.testing.assert_allclose(np.asarray(p1), np.asarray(pN),
@@ -147,36 +172,36 @@ def test_sharded_multistep_stays_in_sync():
 
 
 @pytest.mark.slow
-def test_mp_sharded_step_matches_single_device():
-    """2-D (dp=4, mp=2) mesh: kernels shard over mp, batch over dp; the
-    result must still match the single-device step exactly."""
-    from r2d2_tpu.parallel.mesh import state_shardings
+def test_tp_sharded_step_matches_single_device():
+    """(dp=4, tp=2) mesh: the table column-splits the LSTM/Dense kernels
+    over tp and the batch shards over dp; the result must still match the
+    single-device step at reduction round-off."""
     from jax.sharding import PartitionSpec as P
 
-    cfg = make_test_config(mesh_shape=(("dp", 4), ("mp", 2)))
+    cfg = make_test_config(mesh_shape=(("dp", 4), ("tp", 2)))
     net = create_network(cfg, A)
     params = init_params(cfg, net, jax.random.PRNGKey(2))
     batch = make_batch(cfg, np.random.default_rng(2))
 
-    step1 = jit_train_step(cfg, net)
-    s1, loss1, prio1 = step1(create_train_state(cfg, params),
-                             jax.tree.map(jax.numpy.asarray, batch))
+    step1, s10 = single_device_step(cfg, net, params)
+    s1, loss1, prio1 = step1(s10, dict(batch))
 
     mesh = make_mesh(cfg)
-    assert mesh.shape == {"dp": 4, "mp": 2}
+    assert mesh.shape == {"dp": 4, "fsdp": 1, "tp": 2}
+    table = ShardingTable(mesh, cfg)
     state0 = create_train_state(cfg, params)
-    stepN = sharded_train_step(cfg, net, mesh, state_template=state0)
-    sN0 = replicate_state(mesh, state0)
+    stepN = pjit_train_step(cfg, net, table, state_template=state0)
+    sN0 = table.place_state(state0)
 
-    # the big kernels must actually be mp-sharded (not silently replicated)
-    shards = state_shardings(mesh, state0)
+    # the big kernels must actually be tp-sharded (not silently replicated)
+    shards = table.state_shardings(state0)
     wi_spec = shards.params["params"]["lstm_0"]["wi"].spec
-    assert wi_spec == P(None, "mp")
+    assert wi_spec[-1] == "tp"
     # and the adam moments mirror the param layout
     mu = shards.opt_state[1][0].mu["params"]["lstm_0"]["wi"].spec
-    assert mu == P(None, "mp")
+    assert mu == wi_spec
 
-    sN, lossN, prioN = stepN(sN0, shard_batch(mesh, batch))
+    sN, lossN, prioN = stepN(sN0, shard_batch(table, batch))
     assert float(loss1) == pytest.approx(float(lossN), rel=1e-5)
     np.testing.assert_allclose(np.asarray(prio1), np.asarray(prioN),
                                rtol=1e-4, atol=1e-6)
@@ -185,10 +210,36 @@ def test_mp_sharded_step_matches_single_device():
                                    rtol=1e-4, atol=1e-6)
 
 
-def test_mp_mesh_requires_state_template():
-    cfg = make_test_config(mesh_shape=(("dp", 4), ("mp", 2)))
+@pytest.mark.slow
+def test_fsdp_sharded_step_matches_single_device():
+    """(dp=2, fsdp=2) mesh: params AND adam moments shard a large dim over
+    fsdp — XLA inserts the allgather/reduce-scatter pairs — and training
+    still matches the single-device trajectory."""
+    cfg = make_test_config(mesh_shape=(("dp", 2), ("fsdp", 2)))
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(5))
+    batch = make_batch(cfg, np.random.default_rng(5))
+
+    step1, s10 = single_device_step(cfg, net, params)
+    s1, loss1, _ = step1(s10, dict(batch))
+
+    table = ShardingTable(make_mesh(cfg), cfg)
+    state0 = create_train_state(cfg, params)
+    # at least one kernel must genuinely shard over fsdp
+    shards = table.state_shardings(state0)
+    specs = [s.spec for s in jax.tree.leaves(shards)]
+    assert any("fsdp" in [ax for ax in sp if ax is not None]
+               for sp in specs if sp), specs
+    sN, lossN, _ = pjit_train_step(cfg, net, table, state_template=state0)(
+        table.place_state(state0), shard_batch(table, batch))
+    assert float(loss1) == pytest.approx(float(lossN), rel=1e-5)
+    for p1, pN in zip(jax.tree.leaves(s1.params), jax.tree.leaves(sN.params)):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(pN),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_pjit_step_requires_state_template():
+    cfg = make_test_config(mesh_shape=(("dp", 4), ("tp", 2)))
     net = create_network(cfg, A)
     with pytest.raises(ValueError, match="state_template"):
-        sharded_train_step(cfg, net, make_mesh(cfg))
-
-
+        pjit_train_step(cfg, net, ShardingTable(make_mesh(cfg), cfg))

@@ -1,0 +1,364 @@
+"""The declarative per-param sharding table (parallel/sharding.py).
+
+Resolver contracts (wildcard normalization, longest-match, moments
+inheriting their param's layout, divisibility fallback, unresolved-leaf
+error, the cfg.sharding_table override), the dp=1 vs dp=2 CPU-mesh parity
+of the ONE table-driven pjit train step, its retrace/transfer discipline,
+and the checkpoint resharding roundtrip (save under one mesh, restore and
+re-place under another).
+
+Layout parity caveat, pinned here explicitly: partitioning the batch
+reassociates the gradient reductions (per-shard partial dots + psum vs
+one full-batch dot), so cross-layout trajectories agree to f32
+reduction-order round-off — same-layout reruns are BIT-exact, and both
+are asserted.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.learner.step import create_train_state
+from r2d2_tpu.models.network import create_network, init_params
+from r2d2_tpu.parallel.mesh import AXES, make_mesh, trivial_mesh
+from r2d2_tpu.parallel.sharding import (
+    DEVICE_BATCH_KEYS,
+    ShardingTable,
+    UnresolvedShardingError,
+    normalize_path,
+    normalize_token,
+    parse_table,
+    pjit_train_step,
+    shard_batch,
+)
+from r2d2_tpu.utils.batch import synthetic_batch
+
+A = 4
+
+
+# ------------------------------------------------------------- normalization
+
+def test_normalize_token_wildcards_integer_indices():
+    assert normalize_token("3") == "*"
+    assert normalize_token("lstm_0") == "lstm_*"
+    assert normalize_token("Conv_12") == "Conv_*"
+    assert normalize_token("wi") == "wi"
+    assert normalize_token("kernel") == "kernel"
+
+
+def test_normalize_path():
+    assert normalize_path(("params", "lstm_1", "wi")) == \
+        ("params", "lstm_*", "wi")
+    assert normalize_path(("opt_state", "1", "0", "mu")) == \
+        ("opt_state", "*", "*", "mu")
+
+
+# ------------------------------------------------------------- parse_table
+
+def test_parse_table_clauses():
+    t = parse_table("lstm_*.wh=,tp;head.*.kernel=")
+    assert t["lstm_*.wh"] == (None, "tp")
+    assert t["head.*.kernel"] == ()          # "pattern=" fully replicates
+    t2 = parse_table("torso.Dense_*.kernel=fsdp,tp")
+    assert t2["torso.Dense_*.kernel"] == ("fsdp", "tp")
+
+
+def test_parse_table_rejects_malformed():
+    with pytest.raises(ValueError, match="pattern=axes"):
+        parse_table("lstm_*.wh")
+    with pytest.raises(ValueError, match="empty pattern"):
+        parse_table("=dp")
+    with pytest.raises(ValueError, match="not in"):
+        parse_table("lstm_*.wh=mp")          # the retired axis by name
+
+
+def test_config_validates_sharding_table_and_axes():
+    cfg = make_test_config(sharding_table="lstm_*.wh=,tp")
+    assert cfg.sharding_table == "lstm_*.wh=,tp"
+    with pytest.raises(ValueError, match="not in"):
+        make_test_config(sharding_table="x=bogus")
+    with pytest.raises(ValueError, match="folded into 'tp'"):
+        make_test_config(mesh_shape=(("mp", 2),))
+    with pytest.raises(ValueError, match="duplicate"):
+        make_test_config(mesh_shape=(("dp", 2), ("dp", 2)))
+
+
+# ------------------------------------------------------------- resolution
+
+def table_on(mesh_shape=(), **cfg_kw):
+    cfg = make_test_config(mesh_shape=mesh_shape, **cfg_kw)
+    mesh = make_mesh(cfg) if mesh_shape else trivial_mesh()
+    return ShardingTable(mesh, cfg), cfg
+
+
+def test_lookup_longest_pattern_wins():
+    table, _ = table_on()
+    # a fully-specified override must beat the family wildcard
+    table = ShardingTable(table.mesh, rules={"lstm_*.wh": (None, "tp")})
+    assert table.lookup(("params", "lstm_0", "wh")) == (None, "tp")
+    assert table.lookup(("params", "lstm_3", "wi")) == ("fsdp", "tp")
+
+
+def test_scalars_replicate_without_a_table_entry():
+    table, _ = table_on()
+    # 0-d leaf: no pattern consulted, never an unresolved error
+    assert table.spec(("opt_state", "count"), shape=()) == P()
+
+
+def test_unresolved_leaf_raises():
+    table, _ = table_on()
+    with pytest.raises(UnresolvedShardingError, match="docs/SHARDING.md"):
+        table.spec(("params", "brand_new_family", "w"), shape=(8, 8))
+
+
+def test_divisibility_guard_falls_back_to_replication():
+    table, _ = table_on(mesh_shape=(("dp", 2), ("tp", 2)))
+    # 4H = 64 divides tp=2 → split; an odd output dim must replicate
+    assert table.spec(("params", "lstm_0", "wi"),
+                      shape=(16, 64)) == P("fsdp", "tp")
+    assert table.spec(("params", "head", "value", "kernel"),
+                      shape=(16, 1)) == P("fsdp", None)
+    assert table.spec(("params", "head", "advantage", "bias"),
+                      shape=(5,)) == P(None)
+
+
+def test_entry_longer_than_shape_raises():
+    table, _ = table_on()
+    with pytest.raises(ValueError, match="more dims"):
+        table.spec(("params", "lstm_0", "wi"), shape=(64,))
+
+
+def test_cfg_override_extends_default_table():
+    table, _ = table_on(mesh_shape=(("dp", 2), ("tp", 2)),
+                        sharding_table="lstm_*.wh=;head.*.kernel=")
+    # per-dim None == replicated (P(None, None) ≡ P() to GSPMD)
+    assert table.spec(("params", "lstm_0", "wh"),
+                      shape=(16, 64)) == P(None, None)
+    assert table.spec(("params", "head", "hidden", "kernel"),
+                      shape=(16, 16)) == P(None, None)
+    # untouched entries keep the default layout
+    assert table.spec(("params", "lstm_0", "wi"),
+                      shape=(16, 64)) == P("fsdp", "tp")
+
+
+def test_cfg_override_fully_specified_beats_wildcard_default():
+    """A same-length fully-specified override must shadow the wildcard
+    default ("*" sorts before letters, so a plain lexicographic tiebreak
+    would silently ignore the override)."""
+    table, _ = table_on(mesh_shape=(("dp", 2), ("tp", 2)),
+                        sharding_table="head.value.kernel=")
+    assert table.spec(("params", "head", "value", "kernel"),
+                      shape=(16, 16)) == P(None, None)
+    # sibling leaves still resolve through the wildcard default
+    assert table.spec(("params", "head", "hidden", "kernel"),
+                      shape=(16, 16)) == P("fsdp", "tp")
+
+
+def test_cfg_override_with_concrete_layer_index_normalizes():
+    """Overrides written with concrete layer indices ("lstm_0.wh") must
+    normalize to the wildcard form the leaf-path lookup matches against —
+    a verbatim entry would be a silent no-op."""
+    table, _ = table_on(mesh_shape=(("dp", 2), ("tp", 2)),
+                        sharding_table="lstm_0.wh=")
+    assert table.spec(("params", "lstm_1", "wh"),
+                      shape=(16, 64)) == P(None, None)
+
+
+def test_state_shardings_moments_inherit_param_layout():
+    """adam's mu/nu subtrees carry the same trailing key paths as the
+    params they mirror — one table entry must land on all three of
+    params / target_params / moments identically."""
+    cfg = make_test_config(mesh_shape=(("dp", 4), ("tp", 2)))
+    net = create_network(cfg, A)
+    state = create_train_state(
+        cfg, init_params(cfg, net, jax.random.PRNGKey(0)))
+    table = ShardingTable(make_mesh(cfg), cfg)
+    sh = table.state_shardings(state)
+    p = sh.params["params"]["lstm_0"]["wi"].spec
+    t = sh.target_params["params"]["lstm_0"]["wi"].spec
+    mu = sh.opt_state[1][0].mu["params"]["lstm_0"]["wi"].spec
+    nu = sh.opt_state[1][0].nu["params"]["lstm_0"]["wi"].spec
+    assert p == t == mu == nu
+    assert "tp" in [ax for ax in p if ax is not None]
+    # the step counter and adam's count are scalars → replicated
+    assert sh.step.spec == P()
+
+
+def test_state_shardings_unresolved_leaf_fails_fast():
+    """A model family the table does not know must fail at table
+    resolution — not silently replicate at pod scale."""
+    cfg = make_test_config()
+    table = ShardingTable(trivial_mesh(), cfg)
+    rogue = {"params": {"new_block_0": {"w": np.zeros((8, 8), np.float32)}}}
+    with pytest.raises(UnresolvedShardingError):
+        table.state_shardings(rogue)
+
+
+def test_every_torso_family_resolves():
+    """nature / impala / mlp torsos must all resolve through the default
+    table (the add-a-model-family error stays reserved for genuinely new
+    families)."""
+    for torso, kw in (("nature", dict(obs_shape=(84, 84, 1))),
+                      ("impala", dict(obs_shape=(24, 24, 1),
+                                      obs_space_to_depth=False)),
+                      ("mlp", {})):
+        cfg = make_test_config(torso=torso, **kw)
+        net = create_network(cfg, A)
+        state = create_train_state(
+            cfg, init_params(cfg, net, jax.random.PRNGKey(0)))
+        table = ShardingTable(trivial_mesh(), cfg)
+        table.state_shardings(state)  # must not raise
+
+
+# ------------------------------------------------- unified-step parity
+
+def run_steps(cfg, params, mesh, n_updates=8):
+    """n_updates through THE pjit step on the given mesh; returns
+    (final host params, losses)."""
+    net = create_network(cfg, A)
+    table = ShardingTable(mesh, cfg)
+    state = create_train_state(cfg, params)
+    step = pjit_train_step(cfg, net, table, state_template=state)
+    st = table.place_state(state)
+    losses = []
+    for i in range(n_updates):
+        hb = synthetic_batch(cfg, A, np.random.default_rng(1000 + i))
+        st, loss, _prios = step(st, shard_batch(table, hb))
+        losses.append(float(jax.device_get(loss)))
+    return jax.device_get(st.params), losses
+
+
+@pytest.mark.slow
+def test_dp1_vs_dp2_parity_through_unified_step():
+    """The acceptance pin: dp=1 vs dp=2 CPU-mesh runs of the SAME
+    (only) train-step entry point over >= 8 updates.
+
+    Same-layout reruns are BIT-exact (XLA CPU is deterministic; pinned
+    below).  Across layouts the gradient psum reassociates the batch
+    reduction, so the trajectories agree at f32 reduction round-off —
+    losses to 1e-5 relative, params to 1e-4/1e-7 — the same
+    semantics-preservation contract every mesh variant in this repo has
+    carried since r3 (tests/test_parallel.py tolerances)."""
+    cfg1 = make_test_config(batch_size=8, mesh_shape=(("dp", 1),))
+    cfg2 = make_test_config(batch_size=8, mesh_shape=(("dp", 2),))
+    net = create_network(cfg1, A)
+    params = init_params(cfg1, net, jax.random.PRNGKey(0))
+
+    p1, l1 = run_steps(cfg1, params, make_mesh(cfg1))
+    p2, l2 = run_steps(cfg2, params, make_mesh(cfg2))
+    p2b, l2b = run_steps(cfg2, params, make_mesh(cfg2))
+
+    # same layout, rerun → bit-exact
+    assert l2 == l2b
+    for a, b in zip(jax.tree.leaves(p2), jax.tree.leaves(p2b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # dp=1 vs dp=2 → reduction-order round-off only
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-7)
+
+
+def test_pjit_step_retrace_and_transfer_discipline():
+    """8 same-shape updates = exactly one trace of the step (the RETRACES
+    budget every fabric e2e asserts), and stepping itself crosses the
+    host boundary only for the losses the test fetches."""
+    from r2d2_tpu.utils.trace import RetraceGuard
+
+    cfg = make_test_config(batch_size=8, mesh_shape=(("dp", 2),))
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    table = ShardingTable(make_mesh(cfg), cfg)
+    state = create_train_state(cfg, params)
+
+    # a private guard (the production step registers with the global
+    # RETRACES; wrapping again here would double-count its traces)
+    from r2d2_tpu.learner.step import make_train_step
+    guard = RetraceGuard()
+    st_sh = table.state_shardings(state)
+    from jax.sharding import NamedSharding
+    step = jax.jit(
+        guard.wrap("test.pjit_step", make_train_step(cfg, net)),
+        in_shardings=(st_sh, table.batch_shardings()),
+        out_shardings=(st_sh, table.replicated(),
+                       NamedSharding(table.mesh, P("dp"))),
+        donate_argnums=(0, 1))
+    st = table.place_state(state)
+    for i in range(8):
+        hb = synthetic_batch(cfg, A, np.random.default_rng(i))
+        st, loss, _ = step(st, shard_batch(table, hb))
+    assert guard.counts()["test.pjit_step"] == 1
+    guard.assert_within_budgets()
+
+
+# ------------------------------------------------- checkpoint roundtrip
+
+def test_checkpoint_resharding_roundtrip(tmp_path):
+    """Save a table-sharded state under one mesh, restore it into a host
+    template, and re-place it under a DIFFERENT mesh layout: values must
+    survive bit-exact and the restored state must train under the new
+    layout.  This is the save/restore half the tentpole requires —
+    checkpoints are layout-free, the table re-shards at bring-up."""
+    from r2d2_tpu.checkpoint import Checkpointer
+
+    cfg_a = make_test_config(batch_size=8, mesh_shape=(("dp", 2), ("tp", 2)))
+    net = create_network(cfg_a, A)
+    params = init_params(cfg_a, net, jax.random.PRNGKey(0))
+    p_a, _ = run_steps(cfg_a, params, make_mesh(cfg_a), n_updates=2)
+
+    # save the (dp x tp)-sharded trajectory's state
+    table_a = ShardingTable(make_mesh(cfg_a), cfg_a)
+    state_a = table_a.place_state(create_train_state(cfg_a, params))
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, jax.device_get(state_a), meta=dict(step=1))
+
+    # restore into a host template, re-place under (dp=4, fsdp=1) —
+    # a different layout on the same 8-device host
+    cfg_b = cfg_a.replace(mesh_shape=(("dp", 4),))
+    template = jax.device_get(create_train_state(cfg_b, params))
+    restored, meta = ck.restore(template)
+    table_b = ShardingTable(make_mesh(cfg_b), cfg_b)
+    placed = table_b.place_state(restored)
+
+    # bit-exact roundtrip of every leaf across the resharding
+    for a, b in zip(jax.tree.leaves(jax.device_get(state_a)),
+                    jax.tree.leaves(jax.device_get(placed))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # and the re-placed state trains under the new layout
+    step_b = pjit_train_step(cfg_b, net, table_b, state_template=restored)
+    hb = synthetic_batch(cfg_b, A, np.random.default_rng(0))
+    placed, loss, _ = step_b(placed, shard_batch(table_b, hb))
+    assert np.isfinite(float(jax.device_get(loss)))
+
+
+# ------------------------------------------------- ancillary contracts
+
+def test_batch_shardings_cover_device_batch_keys():
+    table, _ = table_on()
+    sh = table.batch_shardings()
+    assert set(sh) == set(DEVICE_BATCH_KEYS)
+    assert all(s.spec == P("dp") for s in sh.values())
+
+
+def test_ring_and_per_shardings_layouts():
+    table, _ = table_on(mesh_shape=(("dp", 2),))
+    rep = table.ring_shardings("replicated")
+    assert all(s.spec == P() for s in rep.values())
+    dp = table.ring_shardings("dp")
+    assert all(s.spec == P("dp") for s in dp.values())
+    with pytest.raises(ValueError, match="layout"):
+        table.ring_shardings("diagonal")
+    per = table.per_shardings("dp")
+    assert set(per) == {"prios", "seq_meta", "first"}
+    assert all(s.spec == P("dp") for s in per.values())
+
+
+def test_mesh_always_carries_all_three_axes():
+    for spec in ((), (("dp", 2),), (("tp", 2),), (("fsdp", 2), ("tp", 2))):
+        cfg = make_test_config(mesh_shape=spec)
+        assert tuple(make_mesh(cfg).axis_names) == AXES
+    assert tuple(trivial_mesh().axis_names) == AXES

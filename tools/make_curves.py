@@ -118,7 +118,7 @@ def main(out_path: str = None, fabric: bool = False,
                           superstep_k=4, superstep_pipeline=2,
                           in_graph_per=ingraph,
                           **(dict(device_ring_layout="dp",
-                                  mesh_shape=(("dp", 4), ("mp", 2)))
+                                  mesh_shape=(("dp", 4), ("tp", 2)))
                              if dp else {}))
     elif ingraph or dp:
         raise SystemExit("--ingraph/--dp require --fabric (device replay)")

@@ -47,8 +47,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from r2d2_tpu.config import Config, test_config
-from r2d2_tpu.learner.step import create_train_state, jit_train_step
+from r2d2_tpu.learner.step import create_train_state
 from r2d2_tpu.models.network import R2D2Network, create_network, init_params
+from r2d2_tpu.parallel.sharding import pjit_train_step
 from r2d2_tpu.utils.batch import synthetic_batch
 
 
@@ -119,7 +120,9 @@ def _fused_unroll_section(base_cfg, A: int) -> None:
             n = create_network(c, A)
             p = init_params(c, n, jax.random.PRNGKey(0))
             st = create_train_state(c, p)
-            fn = jit_train_step(c, n)
+            # donate_batch=False: this loop re-steps one staged batch
+            fn = pjit_train_step(c, n, state_template=st,
+                                 donate_batch=False)
             b = {k_: jax.device_put(v) for k_, v in
                  synthetic_batch(c, A, np.random.default_rng(0)).items()}
             for _ in range(5):
@@ -233,7 +236,8 @@ def main(quick: bool = False) -> None:
     # (bench's helper hardcodes the flagship Config).
     if quick:
         state = create_train_state(cfg, params)
-        step_fn = jit_train_step(cfg, net)
+        step_fn = pjit_train_step(cfg, net, state_template=state,
+                                  donate_batch=False)
         batch = {k: jax.device_put(v) for k, v in
                  synthetic_batch(cfg, A, np.random.default_rng(0)).items()}
         for _ in range(5):

@@ -1,0 +1,249 @@
+"""pjit micro-benchmarks — the table-driven unified train step per layout.
+
+SNIPPETS.md [2]'s pjit exemplar left "pjit microbenchmarks" as an explicit
+TODO; this is that tool for OUR step: the ONE
+``jit(in_shardings=..., out_shardings=..., donate_argnums=...)`` train
+step (parallel/sharding.py), timed per mesh layout at a matched
+configuration so layout choices are a measurement, not a vibe.
+
+Cells (8 forced virtual CPU host devices unless a real accelerator is
+reachable — the probe is recorded either way, BENCH_r05 convention):
+
+- ``dp1`` … ``dp8``: pure data parallelism (the batch's rows split).
+- ``dp4_tp2`` / ``dp4_fsdp2``: the declarative table's tensor- and
+  param-sharding axes live under the same entry point.
+- ``anakin_cut_on`` / ``anakin_cut_off``: the r9 lax.cond fast path —
+  the fused loop with no-cut steps skipping the block emit/retention
+  gathers vs the always-emit variant (updates/s; the bit-exactness pin
+  is tests/test_anakin.py).
+
+Outputs: ``artifacts/r09/PJIT_BENCH_r09.json`` (summary),
+``artifacts/r09/PJIT_BENCH_r09.telemetry.jsonl`` (one entry per cell,
+telemetry run-log conventions — tools/soak.py's artifact_log), and
+``artifacts/r09/PROBE_r09.json`` (the accelerator probe).
+
+On a CPU host the absolute times are NOT accelerator evidence — the
+cells pin the dispatch/partition overhead story and give the real-chip
+run (standing side-quest) its exact command: ``python tools/pjit_bench.py``
+with the chip visible.
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def probe_accelerator() -> dict:
+    """Bounded probe for a non-CPU backend (the tunneled-chip claim):
+    one subprocess attempt with a hard timeout, recorded either way —
+    the BENCH_r05 convention.  Runs BEFORE this process initialises its
+    own backend so the cells land on the chip when one is visible."""
+    now = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+    code = ("import os,jax,json;"
+            "print(json.dumps([d.platform for d in jax.devices()]))")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=60,
+                           capture_output=True, text=True, env=env)
+        platforms = json.loads(p.stdout.strip() or "[]") if p.returncode == 0 \
+            else []
+    except (subprocess.TimeoutExpired, json.JSONDecodeError):
+        platforms = []
+    reachable = any(pl != "cpu" for pl in platforms)
+    if reachable:
+        note = "cells below ran on this backend (re-run measure_tpu.py too)"
+    elif platforms:
+        note = ("only CPU platforms visible — real-chip pjit cells "
+                "remain a standing side-quest, as in BENCH_r05")
+    else:
+        note = ("backend probe failed to initialise any platform "
+                "(timed out or errored — tunneled chip claim absent or "
+                "wedged); real-chip pjit cells remain a standing "
+                "side-quest, as in BENCH_r05")
+    return dict(probed_at=now, platforms=platforms,
+                accelerator_reachable=reachable, note=note)
+
+
+_PROBE = probe_accelerator()
+if not _PROBE["accelerator_reachable"]:
+    # CPU cells: the virtual mesh needs its device count set before
+    # backend init.  When the probe DID find a chip, neither knob is
+    # touched — the cells run on the real backend.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from r2d2_tpu.config import test_config  # noqa: E402
+from r2d2_tpu.learner.step import create_train_state  # noqa: E402
+from r2d2_tpu.models.network import create_network, init_params  # noqa: E402
+from r2d2_tpu.parallel.mesh import make_mesh  # noqa: E402
+from r2d2_tpu.parallel.sharding import (  # noqa: E402
+    ShardingTable, pjit_train_step, shard_batch)
+from r2d2_tpu.telemetry.runlog import artifact_log  # noqa: E402
+from r2d2_tpu.utils.batch import synthetic_batch  # noqa: E402
+
+OUT = "artifacts/r09/PJIT_BENCH_r09.json"
+PROBE = "artifacts/r09/PROBE_r09.json"
+A = 4
+REPS, WARMUP = 30, 5
+
+# batch 64 over a dp up to 8, mlp test-scale net widened enough that tp /
+# fsdp have a real dim to split (the flagship net doesn't fit a CPU bench)
+BASE = dict(batch_size=64, hidden_dim=128, torso="mlp",
+            obs_shape=(24, 24, 1), burn_in_steps=8, learning_steps=8,
+            forward_steps=2)
+
+LAYOUTS = [
+    ("dp1", (("dp", 1),)),
+    ("dp2", (("dp", 2),)),
+    ("dp4", (("dp", 4),)),
+    ("dp8", (("dp", 8),)),
+    ("dp4_tp2", (("dp", 4), ("tp", 2))),
+    ("dp4_fsdp2", (("dp", 4), ("fsdp", 2))),
+]
+
+
+def pjit_cell(name: str, mesh_shape) -> dict:
+    """Median step time of THE unified train step under one layout.
+
+    The timing loop re-steps one staged batch (donate_batch=False — the
+    training drivetrains donate; see pjit_train_step), fenced by a loss
+    fetch that data-depends on every chained step."""
+    cfg = test_config(mesh_shape=mesh_shape, **BASE)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    state = create_train_state(cfg, params)
+    mesh = make_mesh(cfg)
+    table = ShardingTable(mesh, cfg)
+    step = pjit_train_step(cfg, net, table, state_template=state,
+                           donate_batch=False)
+    st = table.place_state(state)
+    batch = shard_batch(table, synthetic_batch(
+        cfg, A, np.random.default_rng(0)))
+
+    t_compile0 = time.perf_counter()
+    for _ in range(WARMUP):
+        st, loss, _ = step(st, batch)
+    float(jax.device_get(loss))
+    warm = time.perf_counter() - t_compile0
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        st, loss, _ = step(st, batch)
+        float(jax.device_get(loss))   # fence: full fwd/bwd data-dep
+        times.append(time.perf_counter() - t0)
+    ms = float(np.median(times)) * 1000
+    out = dict(cell=name, kind="pjit_step", mesh=dict(mesh.shape),
+               batch_size=cfg.batch_size, step_ms=round(ms, 3),
+               steps_per_sec=round(1000.0 / ms, 2),
+               warmup_s=round(warm, 2), reps=REPS)
+    print(f"{name}: {ms:.2f} ms/step ({out['steps_per_sec']} steps/s)",
+          flush=True)
+    return out
+
+
+def anakin_cell(cut_cond: bool) -> dict:
+    """updates/s of the fused anakin super-step with/without the r9
+    lax.cond cut fast path, on one device (the transport is
+    single-device v1).  block_length is raised toward the flagship
+    regime where the no-cut majority dominates."""
+    from r2d2_tpu.envs.anakin import AnakinFakeEnv
+    from r2d2_tpu.learner.anakin import (
+        make_anakin_state, make_anakin_super_step)
+    from r2d2_tpu.replay.device_ring import DeviceRing
+
+    cfg = test_config(
+        game_name="Fake", actor_transport="anakin", num_actors=8,
+        device_replay=True, in_graph_per=True, superstep_k=4,
+        block_length=64, max_episode_steps=10 ** 9,
+        anakin_episode_len=512, buffer_capacity=64 * 32,
+        burn_in_steps=8, learning_steps=8, forward_steps=2,
+        batch_size=16, hidden_dim=64, torso="mlp", obs_shape=(24, 24, 1))
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    state = create_train_state(cfg, params)
+    ring = DeviceRing(cfg, A)
+    env = AnakinFakeEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                        episode_len=cfg.anakin_episode_len,
+                        num_lanes=cfg.num_actors)
+    ast = make_anakin_state(cfg, A, env, jax.random.PRNGKey(1))
+    fn = make_anakin_super_step(cfg, net, env, A, cut_cond=cut_cond)
+    meta = ring.per_meta()
+    args = (state, ast, ring.snapshot(), ring.take_prios(),
+            meta["seq_meta"], meta["first"])
+
+    k = cfg.superstep_k
+    n_disp, t0 = 0, None
+    flat = None
+    for i in range(WARMUP + REPS):
+        out = fn(*args, jnp.uint32(i))
+        args, flat = out[:-1], out[-1]
+        if i + 1 == WARMUP:
+            np.asarray(flat)          # fence, then start the clock
+            t0 = time.perf_counter()
+        elif i >= WARMUP:
+            n_disp += 1
+    np.asarray(flat)                   # fence the tail
+    dt = time.perf_counter() - t0
+    ups = n_disp * k / dt
+    name = f"anakin_cut_{'on' if cut_cond else 'off'}"
+    out = dict(cell=name, kind="anakin_super_step", cut_cond=cut_cond,
+               lanes=cfg.num_actors, block_length=cfg.block_length,
+               superstep_k=k,
+               env_steps_per_update=cfg.anakin_env_steps_per_update,
+               updates_per_sec=round(ups, 2),
+               dispatch_ms=round(dt / n_disp * 1000, 2))
+    print(f"{name}: {out['updates_per_sec']} updates/s "
+          f"({out['dispatch_ms']} ms/dispatch)", flush=True)
+    return out
+
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def main() -> int:
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    runlog = artifact_log(OUT, "pjit_bench_telemetry.jsonl")
+    started = time.time()
+    cells = []
+    for name, mesh_shape in LAYOUTS:
+        c = pjit_cell(name, mesh_shape)
+        cells.append(c)
+        runlog.append(dict(time=time.time(), **c))
+    for cut in (False, True):
+        c = anakin_cell(cut)
+        cells.append(c)
+        runlog.append(dict(time=time.time(), **c))
+    probe = _PROBE   # probed at module init, before backend selection
+    with open(PROBE, "w") as f:
+        json.dump(probe, f, indent=1)
+
+    by = {c["cell"]: c for c in cells}
+    summary = dict(
+        generated_at=datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+        backend=jax.default_backend(),
+        host_cpus=os.cpu_count(), wall_seconds=round(
+            time.time() - started, 1),
+        cells=cells, probe=probe,
+        anakin_cut_speedup=round(
+            by["anakin_cut_on"]["updates_per_sec"]
+            / by["anakin_cut_off"]["updates_per_sec"], 3),
+    )
+    with open(OUT, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(f"wrote {OUT} (+ telemetry jsonl) and {PROBE}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

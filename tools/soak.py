@@ -15,9 +15,10 @@ Run:  python tools/soak.py [minutes] [--device] [--ingraph] [--dp]
 priority feedback never crosses the host, and note_updates keeps the
 accounting check exact.
 
-``--dp`` soaks the dp-sharded ring composition on a virtual dp=4 x mp=2
+``--dp`` soaks the dp-sharded ring composition on a virtual dp=4 x tp=2
 CPU mesh (8 forced host devices) — with ``--ingraph`` that is the
-pod-layout device-PER fabric (per-slab shard_map sampling).
+pod-layout device-PER fabric (table-driven pjit step, global
+stratified sampling over the dp-sharded PER leaves).
 """
 import json
 import os
@@ -75,7 +76,7 @@ def main(minutes: float = 20.0) -> int:
         actor_fleets=2, env_workers=2,
         training_steps=10**9, log_interval=10.0,
         **(dict(device_ring_layout="dp",
-                mesh_shape=(("dp", 4), ("mp", 2))) if DP else {}))
+                mesh_shape=(("dp", 4), ("tp", 2))) if DP else {}))
     t0 = time.time()
     # machine-readable per-interval telemetry next to the summary
     # artifact — every stats entry, one JSON line each, so a soak is
