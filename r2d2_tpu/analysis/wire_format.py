@@ -1,13 +1,15 @@
 """wire-format: the shm slot layout and CRC live in ONE module.
 
-Three modules speak the shared-memory wire format (``replay/block.py``
-defines it; ``parallel/actor_procs.py`` and
-``parallel/inference_service.py`` transport over it).  The CRC32
-convention — int64 header words, payload arrays in declared order, the
-32-bit mask, written LAST — is a torn-write detector only as long as the
-producer and verifier agree bit-for-bit; a restated literal in one of the
-transport modules is exactly the kind of drift that ships silently and
-corrupts recovery later.
+Four modules speak the shared-memory wire format (``replay/block.py``
+defines it; ``parallel/actor_procs.py``,
+``parallel/inference_service.py`` and ``parallel/replay_shards.py`` —
+the sharded replay plane's block-routing and sample-RPC slabs —
+transport over it).  The CRC32 convention — int64 header words, payload
+arrays in declared order, the 32-bit mask, written LAST — is a
+torn-write detector only as long as the producer and verifier agree
+bit-for-bit; a restated literal in one of the transport modules is
+exactly the kind of drift that ships silently and corrupts recovery
+later.
 
 The rule fires in any module that imports ``multiprocessing
 .shared_memory`` (the shm-transport signature) **other than the wire
@@ -16,8 +18,9 @@ The rule fires in any module that imports ``multiprocessing
 - calls ``zlib.crc32`` directly (use ``replay.block.payload_crc32``),
 - restates the 32-bit CRC mask literal ``0xFFFFFFFF``,
 - re-defines a wire-format function (``slot_layout`` / ``slot_views`` /
-  ``slot_crc`` / ``block_slot_spec`` / ``write_block`` / ``read_block``
-  / ``payload_crc32``) instead of importing it,
+  ``slot_crc`` / ``block_slot_spec`` / ``batch_slot_spec`` /
+  ``write_block`` / ``read_block`` / ``payload_crc32``) instead of
+  importing it,
 - uses a wire-format name without importing it from
   ``r2d2_tpu.replay.block``.
 """
@@ -33,7 +36,8 @@ RULE = "wire-format"
 WIRE_MODULE = "r2d2_tpu.replay.block"
 WIRE_MODULE_SUFFIX = "replay/block.py"
 WIRE_NAMES = {"slot_layout", "slot_views", "slot_crc", "block_slot_spec",
-              "write_block", "read_block", "payload_crc32", "CRC_MASK"}
+              "batch_slot_spec", "write_block", "read_block",
+              "payload_crc32", "CRC_MASK", "BATCH_ROW_FIELDS"}
 CRC_MASK_VALUE = 0xFFFFFFFF
 
 

@@ -152,6 +152,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "inference (circuit breaker, "
                          "utils/resilience.py); overrides "
                          "cfg.act_response_timeout (must be > 0)")
+    pt.add_argument("--replay-shards", type=int, default=None, metavar="K",
+                    help="shard the host replay plane across K owner "
+                         "processes (parallel/replay_shards.py): ingest "
+                         "routes blocks to shards over the shm block "
+                         "wire format, sampling becomes per-shard "
+                         "stratified RPCs answered with preassembled "
+                         "batches, priority feedback fans back out; "
+                         "sampling stays distribution-equivalent to the "
+                         "in-process path (K=1, default).  The sample "
+                         "RPC deadline is cfg.replay_sample_timeout "
+                         "(--set replay_sample_timeout=SECS); overrides "
+                         "cfg.replay_shards")
     pt.add_argument("--mesh", action="store_true",
                     help="GSPMD learner over all visible devices: one "
                          "table-driven pjit train step on the dp x fsdp x "
@@ -229,6 +241,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.act_response_timeout is not None:
                 cfg = cfg.replace(
                     act_response_timeout=args.act_response_timeout)
+            if args.replay_shards is not None:
+                cfg = cfg.replace(replay_shards=args.replay_shards)
             if args.sharding_table is not None:
                 cfg = cfg.replace(sharding_table=args.sharding_table)
         except ValueError as e:

@@ -284,6 +284,36 @@ class Config:
                                       # compile; the old behavior was a
                                       # hardcoded 600 s then a fleet-
                                       # killing RuntimeError)
+    replay_shards: int = 1            # host replay owner processes
+                                      # (parallel/replay_shards.py): 1 =
+                                      # the in-process ring+sum-tree (the
+                                      # default, unchanged code shape);
+                                      # K > 1 splits the ring across K
+                                      # spawn-started shard processes —
+                                      # ingest routes blocks round-robin
+                                      # over the shm block wire format,
+                                      # the learner's sample thread
+                                      # issues stratified sample RPCs
+                                      # answered with preassembled
+                                      # batches over preallocated
+                                      # response slabs, and priority
+                                      # feedback fans back to the owning
+                                      # shards.  Strata allocate across
+                                      # shards proportionally to priority
+                                      # mass, so sampling stays
+                                      # content-for-content
+                                      # distribution-equivalent to K=1.
+                                      # Host replay only (device_replay
+                                      # keeps its own device sharding);
+                                      # num_blocks must divide by K
+    replay_sample_timeout: float = 5.0  # sharded replay: per-RPC deadline
+                                      # the sample thread waits on one
+                                      # shard's preassembled batch before
+                                      # marking it suspect and
+                                      # redistributing its rows over the
+                                      # healthy shards' mass (the learner
+                                      # never stalls on a dead or stalled
+                                      # shard); must be > 0
     device_replay: bool = False       # replay data lives in HBM; batches
                                       # are gathered in-graph (device_ring)
     device_ring_layout: str = "auto"  # "replicated" (full ring per device)
@@ -498,6 +528,28 @@ class Config:
             raise ValueError("superstep_k must be >= 1")
         if self.superstep_pipeline < 0:
             raise ValueError("superstep_pipeline must be >= 0")
+        if self.replay_shards < 1:
+            raise ValueError("replay_shards must be >= 1 (1 = in-process)")
+        if self.replay_shards > 1:
+            if self.device_replay:
+                raise ValueError(
+                    "replay_shards > 1 shards the HOST replay plane; "
+                    "device_replay has its own dp slot sharding "
+                    "(device_ring_layout) — pick one")
+            if self.actor_transport == "anakin":
+                raise ValueError(
+                    "replay_shards > 1 is meaningless under the anakin "
+                    "transport (the fused loop keeps replay on-device)")
+            if self.num_blocks % self.replay_shards:
+                raise ValueError(
+                    f"num_blocks ({self.num_blocks}) must divide evenly "
+                    f"over replay_shards ({self.replay_shards}) so every "
+                    "shard owns an equal slot slice")
+        if self.replay_sample_timeout <= 0:
+            raise ValueError(
+                "replay_sample_timeout must be > 0 (the sample RPC "
+                "deadline is what keeps a dead shard from wedging the "
+                "sample thread — there is no unbounded mode)")
         if self.in_graph_per and not self.device_replay:
             raise ValueError("in_graph_per requires device_replay=True "
                              "(sampling reads the HBM-resident ring)")

@@ -310,60 +310,122 @@ class ReplayBuffer:
                 "sample_batch needs host data arrays; this buffer runs "
                 "device_replay — use sample_meta + the in-graph gather")
         B = batch_size or cfg.batch_size
-        K, L, T = cfg.seqs_per_block, cfg.learning_steps, cfg.seq_len
         with self.lock:
             if self.size == 0:
                 raise RuntimeError(
                     "sample_batch on an empty buffer; wait for add() (use "
                     "`ready` to gate on learning_starts)")
             idxes, is_weights = self.tree.sample(B)
-            block_idx = idxes // K
-            seq_idx = idxes % K
-
-            burn_in = self.burn_in_steps[block_idx, seq_idx].astype(np.int64)
-            learning = self.learning_steps[block_idx, seq_idx].astype(np.int64)
-            forward = self.forward_steps[block_idx, seq_idx].astype(np.int64)
-
-            # obs-coordinate window start: first burn-in prefix + k full
-            # learning windows (worker.py:186), reaching back over this
-            # sequence's own burn-in.
-            #
-            # INVARIANT (load-bearing): the clamp below pads short sequences
-            # with whatever bytes previously occupied the ring slot.  This is
-            # safe because every index the learner gathers is
-            # < burn_in + learning + forward (learner/step.py:_window_indices
-            # clamps to that bound), i.e. strictly before the stale region,
-            # and loss/priorities are masked to the learning window.  The
-            # stale tail does flow through the LSTM scan, but only *after*
-            # the last gathered timestep, so it cannot affect any used
-            # output.  Tested in tests/test_replay_buffer.py.
-            start = self.first_burn_in[block_idx] + seq_idx * L
-            t0 = start - burn_in
-            time_idx = np.minimum(t0[:, None] + np.arange(T), cfg.max_block_steps - 1)
-            bcol = block_idx[:, None]
-            obs = self.obs[bcol, time_idx]
-            last_action = self.last_action[bcol, time_idx].astype(np.float32)
-            last_reward = self.last_reward[bcol, time_idx]
-
-            widx = np.minimum(seq_idx[:, None] * L + np.arange(L), cfg.block_length - 1)
-            action = self.action[bcol, widx].astype(np.int32)
-            n_step_reward = self.n_step_reward[bcol, widx]
-            n_step_gamma = self.n_step_gamma[bcol, widx]
-            hidden = self.hidden[block_idx, seq_idx]
-
             batch = dict(
-                obs=obs, last_action=last_action, last_reward=last_reward,
-                hidden=hidden, action=action,
-                n_step_reward=n_step_reward, n_step_gamma=n_step_gamma,
-                burn_in=burn_in.astype(np.int32),
-                learning=learning.astype(np.int32),
-                forward=forward.astype(np.int32),
+                self._gather_rows(idxes),
                 is_weights=is_weights.astype(np.float32),
                 idxes=idxes,
                 block_ptr=self.block_ptr,
                 env_steps=self.env_steps,
             )
         return batch
+
+    def _gather_rows(self, idxes: np.ndarray,
+                     out: Optional[Dict[str, np.ndarray]] = None
+                     ) -> Dict[str, np.ndarray]:
+        """The vectorised fancy-index gather of the per-row batch fields
+        for leaf ``idxes`` — the assembly core shared by
+        :meth:`sample_batch` (K=1 in-process path) and
+        :meth:`serve_sample` (a sharded-plane owner process gathering its
+        preassembled response rows).  Caller holds the lock.
+
+        ``out``: destination views (the sharded plane's response slab,
+        each already sliced to ``len(idxes)`` rows) — the dominant
+        ``obs`` gather then runs as ONE ``np.take(..., out=)`` pass
+        straight into the slab instead of materialising an intermediate
+        batch-sized array first (tens of MB per RPC at pong scale).
+
+        INVARIANT (load-bearing): the clamp below pads short sequences
+        with whatever bytes previously occupied the ring slot.  This is
+        safe because every index the learner gathers is
+        < burn_in + learning + forward (learner/step.py:_window_indices
+        clamps to that bound), i.e. strictly before the stale region,
+        and loss/priorities are masked to the learning window.  The
+        stale tail does flow through the LSTM scan, but only *after*
+        the last gathered timestep, so it cannot affect any used
+        output.  Tested in tests/test_replay_buffer.py.
+        """
+        cfg = self.cfg
+        K, L, T = cfg.seqs_per_block, cfg.learning_steps, cfg.seq_len
+        block_idx = idxes // K
+        seq_idx = idxes % K
+
+        burn_in = self.burn_in_steps[block_idx, seq_idx].astype(np.int64)
+        learning = self.learning_steps[block_idx, seq_idx].astype(np.int64)
+        forward = self.forward_steps[block_idx, seq_idx].astype(np.int64)
+
+        # obs-coordinate window start: first burn-in prefix + k full
+        # learning windows (worker.py:186), reaching back over this
+        # sequence's own burn-in.
+        start = self.first_burn_in[block_idx] + seq_idx * L
+        t0 = start - burn_in
+        time_idx = np.minimum(t0[:, None] + np.arange(T),
+                              cfg.max_block_steps - 1)
+        bcol = block_idx[:, None]
+        widx = np.minimum(seq_idx[:, None] * L + np.arange(L),
+                          cfg.block_length - 1)
+        if out is None:
+            return dict(
+                obs=self.obs[bcol, time_idx],
+                last_action=self.last_action[bcol, time_idx].astype(
+                    np.float32),
+                last_reward=self.last_reward[bcol, time_idx],
+                hidden=self.hidden[block_idx, seq_idx],
+                action=self.action[bcol, widx].astype(np.int32),
+                n_step_reward=self.n_step_reward[bcol, widx],
+                n_step_gamma=self.n_step_gamma[bcol, widx],
+                burn_in=burn_in.astype(np.int32),
+                learning=learning.astype(np.int32),
+                forward=forward.astype(np.int32),
+            )
+        n = idxes.shape[0]
+        # obs dominates the batch bytes: one flat-index take straight
+        # into the destination (same [block, time] pairs as the fancy
+        # gather above — bit-identical rows, one fewer full pass)
+        flat_t = (block_idx[:, None] * cfg.max_block_steps
+                  + time_idx).ravel()
+        np.take(self.obs.reshape(cfg.num_blocks * cfg.max_block_steps, -1),
+                flat_t, axis=0, out=out["obs"].reshape(n * T, -1))
+        # the rest is small relative to obs: plain gathers/casts into out
+        out["last_action"][...] = self.last_action[bcol, time_idx]
+        out["last_reward"][...] = self.last_reward[bcol, time_idx]
+        out["hidden"][...] = self.hidden[block_idx, seq_idx]
+        out["action"][...] = self.action[bcol, widx]
+        out["n_step_reward"][...] = self.n_step_reward[bcol, widx]
+        out["n_step_gamma"][...] = self.n_step_gamma[bcol, widx]
+        out["burn_in"][...] = burn_in
+        out["learning"][...] = learning
+        out["forward"][...] = forward
+        return out
+
+    def serve_sample(self, n: int,
+                     out: Optional[Dict[str, np.ndarray]] = None):
+        """One shard-side sample service call (the sharded replay plane's
+        owner processes, parallel/replay_shards.py): a stratified draw of
+        ``n`` rows over THIS buffer's own tree plus the gathered row
+        fields.  Returns ``(rows, idxes, raw_prios, block_ptr,
+        env_steps)`` — priorities travel RAW (no zero-clamp, no IS
+        normalisation) because the trainer-side coordinator normalises by
+        the min across ALL shards' rows at once, preserving the K=1
+        min-of-the-whole-batch scheme; ``block_ptr`` is this buffer's
+        local FIFO pointer, which the shard's own
+        :meth:`update_priorities` stale-mask needs at feedback time.
+        ``out``: response-slab destination views (already sliced to
+        ``n`` rows) the gather writes straight into."""
+        with self.lock:
+            if self.size == 0 or self.tree.total <= 0:
+                # the coordinator's mass vector can be one publish stale —
+                # answer empty instead of raising so the trainer
+                # redistributes the rows over the shards that have mass
+                return None
+            idxes, prios = self.tree.sample(n, raw=True)
+            rows = self._gather_rows(idxes, out=out)
+            return rows, idxes, prios, self.block_ptr, self.env_steps
 
     # ---------------------------------------------------------- sample (meta)
     def sample_meta(self, k: int, batch_size: Optional[int] = None,
@@ -613,6 +675,11 @@ class ReplayBuffer:
                 episode_reward=self.episode_reward,
                 sum_loss=self.sum_loss,
                 corrupt_blocks=self.corrupt_blocks,
+                # the in-process buffer has no owner processes to lose;
+                # the key exists so the log plane / r2d2_top render one
+                # schema whether replay is sharded
+                # (parallel/replay_shards.py reports real counts) or not
+                shard_respawns=0,
             )
             self.episode_reward = 0.0
             self.num_episodes = 0

@@ -83,7 +83,8 @@ class SumTree:
             targets = np.where(go_right, targets - left_mass, targets)
         return nodes
 
-    def sample(self, num_samples: int) -> Tuple[np.ndarray, np.ndarray]:
+    def sample(self, num_samples: int, raw: bool = False
+               ) -> Tuple[np.ndarray, np.ndarray]:
         """Stratified proportional sample of ``num_samples`` leaves.
 
         The total mass is split into equal strata with one uniform draw each,
@@ -91,6 +92,13 @@ class SumTree:
         Returns (leaf indices, IS weights).  Weights are ``(p/min_p)^-beta``
         normalised by the minimum *sampled* priority, so they lie in (0, 1]
         — the reference's scheme, which avoids a global min-tree.
+
+        ``raw=True`` returns the sampled leaf priorities UNNORMALISED (and
+        un-clamped) in the weights slot instead: the sharded replay plane's
+        shard servers draw per-shard rows this way and the trainer-side
+        coordinator applies the zero-leaf clamp + min-normalisation across
+        ALL shards' rows at once, preserving the K=1 min-of-the-whole-batch
+        IS scheme content-for-content (parallel/replay_shards.py).
         """
         total = self.nodes[0]
         if total <= 0:
@@ -101,6 +109,8 @@ class SumTree:
         nodes = self._descend(targets)
 
         prios = self.nodes[nodes]
+        if raw:
+            return nodes - self.leaf_offset, prios.copy()
         # numerical guard: a descent can land on a zero leaf when float error
         # accumulates; clamp to the smallest positive sampled priority
         pos = prios[prios > 0]

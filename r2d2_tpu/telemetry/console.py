@@ -30,6 +30,16 @@ def format_entry(entry: Dict[str, Any], prefix: str = "[r2d2]") -> str:
         totals = stats.get("totals") or {}
         if totals.get("env_steps"):
             line += f" fleet_env_steps={int(totals['env_steps'])}"
+    rs = entry.get("replay_shards")
+    if rs:
+        line += f" shards={rs.get('alive', 0)}/{rs.get('shards', 0)}"
+        respawns = sum(rs.get("respawns", []))
+        if respawns:
+            line += f" shard_respawns={respawns}"
+        if rs.get("sample_timeouts"):
+            line += f" shard_timeouts={rs['sample_timeouts']}"
+    if entry.get("corrupt_blocks"):
+        line += f" corrupt_blocks={entry['corrupt_blocks']}"
     age = entry.get("learner_heartbeat_age")
     if age is not None and age > 5.0:
         line += f" heartbeat_age={age:.1f}s"

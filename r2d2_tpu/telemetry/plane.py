@@ -180,6 +180,39 @@ class Telemetry:
                 for f, st in enumerate(res.get("circuit_states", [])):
                     reg.set_gauge("resilience.circuit_state", st,
                                   fleet=str(f))
+        # sharded replay plane (parallel/replay_shards.py): shard health
+        # + the coordinator's routing/RPC counters under replay.shard.*.
+        # Event counters the plane already writes LIVE with a {shard}
+        # label (respawns, dropped_blocks, sample_timeouts, redraws,
+        # garbled_responses, stale_feedback) are NOT re-absorbed here
+        # unlabeled: two label schemas under one name double-count every
+        # event in any sum() over the metric — per-shard series plus
+        # label aggregation are the one view
+        rs = entry.get("replay_shards")
+        if rs:
+            reg.set_gauge("replay.shard.total", rs.get("shards", 0))
+            reg.set_gauge("replay.shard.alive", rs.get("alive", 0))
+            reg.counter_max("replay.shard.blocks_routed",
+                            rs.get("blocks_routed", 0))
+            reg.counter_max("replay.shard.corrupt_blocks",
+                            rs.get("corrupt_blocks", 0))
+            reg.counter_max("replay.shard.sample_retries",
+                            rs.get("sample_retries", 0))
+            for sh, m in enumerate(rs.get("masses", [])):
+                reg.set_gauge("replay.shard.mass", m, shard=str(sh))
+            for sh, n in enumerate(rs.get("sizes", [])):
+                reg.set_gauge("replay.shard.size", n, shard=str(sh))
+            for sh, n in enumerate(rs.get("per_shard_corrupt", [])):
+                reg.counter_max("replay.shard.shard_corrupt_blocks", n,
+                                shard=str(sh))
+        # shard-health drive-by on the base stats schema (zero on the
+        # in-process path — replay.corrupt_blocks also covers the K=1
+        # buffer's wire-format drops); shard_respawns stays entry/console
+        # only: as a registry name it would flatten onto the same
+        # Prometheus series as the plane's replay.shard.respawns{shard}
+        if "corrupt_blocks" in entry:
+            reg.counter_max("replay.corrupt_blocks",
+                            entry["corrupt_blocks"])
         # anakin fused-loop surface (train._train_anakin's log loop): the
         # transport is single-process by construction, so its counters
         # publish straight through the registry — no shm slab involved

@@ -232,9 +232,24 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                       checkpointer=checkpointer,
                       start_env_steps=start_env_steps,
                       start_minutes=start_minutes, table=table)
-    buffer = ReplayBuffer(cfg, action_dim,
-                          rng=np.random.default_rng(cfg.seed),
-                          device_ring=ring)
+    replay_plane = None
+    if cfg.replay_shards > 1:
+        # sharded replay plane (parallel/replay_shards.py): K owner
+        # processes each run the ReplayBuffer core over their slot
+        # slice; this coordinator facade fills the buffer role in the
+        # fabric (add/ready/sample_batch/update_priorities/stats/
+        # snapshots).  Processes spawn in train() at plane start, like
+        # the fleet plane.  Config validation already rejected
+        # device_replay here, so `ring` is None on this path.
+        from r2d2_tpu.parallel.replay_shards import ShardedReplayPlane
+
+        buffer = ShardedReplayPlane(
+            cfg, action_dim, rng=np.random.default_rng(cfg.seed))
+        replay_plane = buffer
+    else:
+        buffer = ReplayBuffer(cfg, action_dim,
+                              rng=np.random.default_rng(cfg.seed),
+                              device_ring=ring)
     buffer.env_steps = start_env_steps
     epsilons = [epsilon_ladder(i, cfg.num_actors, cfg.base_eps, cfg.eps_alpha)
                 for i in range(cfg.num_actors)]
@@ -303,7 +318,8 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
     return dict(cfg=cfg, envs=envs, action_dim=action_dim, net=net,
                 learner=learner, buffer=buffer, actors=actors,
                 actor=actors[0] if actors else None, plane=plane,
-                param_store=param_store, restored_replay=restored_replay,
+                replay_plane=replay_plane, param_store=param_store,
+                restored_replay=restored_replay,
                 checkpointer=checkpointer, host_bs=host_bs, ring=ring)
 
 
@@ -469,7 +485,7 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     cfg = cfg.replace(prefetch_batches=0, env_workers=0, actor_fleets=1,
                       device_replay=False, in_graph_per=False,
                       superstep_pipeline=0, actor_transport="thread",
-                      actor_inference="local")
+                      actor_inference="local", replay_shards=1)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     cfg = sys["cfg"]
     actor: VectorActor = sys["actor"]
@@ -807,6 +823,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     learner: Learner = sys["learner"]
     checkpointer = sys["checkpointer"]
     plane = sys["plane"]
+    replay_plane = sys["replay_plane"]
     tracer = tracer or Tracer()
     scaffold = _HostScaffold(cfg, checkpoint_dir,
                              max_wall_seconds=max_wall_seconds,
@@ -850,6 +867,16 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     want_full_save = (checkpointer is not None and cfg.replay_snapshot
                       and sys["ring"] is None and jax.process_count() == 1)
 
+    if replay_plane is not None:
+        # shard counters land in the run's namespace (replay.shard.*);
+        # the Checkpointer lets the watchdog restore a respawned shard's
+        # slots from the latest committed replay snapshot; the chaos
+        # injector arms the garble_sample_response receipt-side site
+        replay_plane.set_registry(telemetry.registry)
+        if want_full_save:
+            replay_plane.checkpointer = checkpointer
+        replay_plane.chaos = chaos
+
     def learner_stop() -> bool:
         if chaos is not None:
             freeze = chaos.learner_freeze_seconds()
@@ -874,7 +901,15 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 time.sleep(0.05)
                 continue
             with tracer.span("buffer.sample_batch"):
-                batch = buffer.sample_batch(sys["host_bs"])
+                if replay_plane is not None:
+                    # the scatter/gather sample RPC; None = every shard
+                    # suspect/empty this draw (all RPC deadlines are
+                    # bounded) — retry, the watchdog respawns the dead
+                    batch = buffer.sample_batch(sys["host_bs"], stop=stop)
+                    if batch is None:
+                        continue
+                else:
+                    batch = buffer.sample_batch(sys["host_bs"])
             while not stop():
                 try:
                     batch_queue.put(batch, timeout=0.1)
@@ -907,7 +942,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                  and age > cfg.learner_stall_timeout)
         out = dict(
             ok=not (supervisor.any_failed or stall["stalled"] or stale
-                    or (plane is not None and plane.failed)),
+                    or (plane is not None and plane.failed)
+                    or (replay_plane is not None and replay_plane.failed)),
             learner_heartbeat_age=age,
             learner_stalled=stall["stalled"] or stale,
             threads=supervisor.health(),
@@ -919,6 +955,15 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                                 restarts=h["restarts"], failed=h["failed"],
                                 resilience=h["resilience"])
             degraded = bool(h["resilience"].get("degraded"))
+        if replay_plane is not None:
+            rh = replay_plane.health()
+            out["replay_shards"] = dict(shards=rh["shards"],
+                                        alive=rh["alive"],
+                                        respawns=rh["respawns"],
+                                        failed=rh["failed"])
+            # a dead shard mid-respawn: the plane keeps serving from the
+            # survivors (redistributed strata) — degraded, not failing
+            degraded = degraded or bool(rh["degraded"])
         out["degraded"] = degraded and out["ok"]
         out["status"] = ("failing" if not out["ok"]
                          else "degraded" if degraded else "ok")
@@ -953,6 +998,12 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 entry["chaos"] = chaos.counts()
             if plane is not None:
                 entry["fleet"] = plane.health()
+            if replay_plane is not None:
+                entry["replay_shards"] = replay_plane.health()
+            # shard-health drive-bys ride the base stats schema (zeros on
+            # the in-process path) so r2d2_top renders one line format
+            entry["corrupt_blocks"] = s["corrupt_blocks"]
+            entry["shard_respawns"] = s.get("shard_respawns", 0)
             logs.append(entry)
             # registry absorption + the persistent JSONL record
             telemetry.record(entry)
@@ -963,13 +1014,18 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             last_steps, last_time = s["training_steps"], now
 
     def chaos_loop():
-        # process-plane fault sites (fleet kill, slab garbling); learner
-        # freeze fires from learner_stop, checkpoint truncation from the
-        # Checkpointer itself
+        # process-plane fault sites (fleet kill, slab garbling, replay
+        # shard kill/stall); learner freeze fires from learner_stop,
+        # checkpoint truncation from the Checkpointer itself, sample-
+        # response garbling from the replay plane's receipt path
         while not stop():
             time.sleep(0.05)
-            chaos.maybe_kill_fleet(plane)
-            chaos.maybe_garble_block(plane)
+            if plane is not None:
+                chaos.maybe_kill_fleet(plane)
+                chaos.maybe_garble_block(plane)
+            if replay_plane is not None:
+                chaos.maybe_kill_replay_shard(replay_plane)
+                chaos.maybe_stall_shard(replay_plane)
 
     def snapshot_loop():
         # periodic insurance against kill -9 (no drain possible): the
@@ -980,15 +1036,27 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             time.sleep(0.2)
             if time.time() - last < cfg.replay_snapshot_interval:
                 continue
-            sys["checkpointer"].save_replay(buffer.training_steps,
-                                            buffer.write_state)
+            try:
+                sys["checkpointer"].save_replay(buffer.training_steps,
+                                                buffer.write_state)
+            except Exception as e:
+                # a snapshot is insurance, not the run: a replay shard
+                # dying mid-fan-out (chaos kill) fails THIS save — warn
+                # and retry next cadence instead of burning the loop's
+                # supervisor restart budget (the shutdown save is
+                # equally tolerant)
+                log.warning("periodic replay snapshot failed: %s", e)
             last = time.time()
 
     loops = [(f"actor{f}" if len(actors) > 1 else "actor",
               make_actor_loop(a)) for f, a in enumerate(actors)]
     loops += scaffold.watch_loops()
-    if chaos is not None and plane is not None and (
-            chaos.enabled("kill_fleet") or chaos.enabled("garble_block")):
+    if chaos is not None and (
+            (plane is not None and (chaos.enabled("kill_fleet")
+                                    or chaos.enabled("garble_block")))
+            or (replay_plane is not None
+                and (chaos.enabled("kill_replay_shard")
+                     or chaos.enabled("stall_shard")))):
         loops.append(("chaos", chaos_loop))
     if want_full_save and cfg.replay_snapshot_interval > 0:
         loops.append(("snapshot", snapshot_loop))
@@ -997,6 +1065,9 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         # plumbing (block ingest, weight pump, process watchdog) runs as
         # supervised fabric threads just like the actor threads would
         loops += plane.make_loops(stop, buffer.add)
+    if replay_plane is not None:
+        # sharded replay: the shard-process watchdog (respawn + restore)
+        loops += replay_plane.make_loops(stop)
     loops += [("sample", sample_loop), ("priority", priority_loop),
               ("log", log_loop)]
     loops += scaffold.exporter_loops(healthz)
@@ -1047,6 +1118,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     try:
         fleet_snaps = None
         try:
+            if replay_plane is not None:
+                # shard processes first: every other plane's ingest path
+                # routes into them (restores armed by _build apply here)
+                replay_plane.start()
             if plane is not None:
                 plane.start(sys["param_store"])
             scaffold.start(loops)
@@ -1106,6 +1181,12 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             metrics["chaos"] = chaos.counts()
         if plane is not None:
             metrics["fleet_health"] = plane.health()
+        if replay_plane is not None:
+            metrics["replay_shard_health"] = replay_plane.health()
         return metrics
     finally:
+        # AFTER the epilogue: the priority drain and the full-state
+        # snapshot fan-out above both need live shard processes
+        if replay_plane is not None:
+            replay_plane.shutdown()
         scaffold.close()

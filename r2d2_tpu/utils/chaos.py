@@ -41,6 +41,23 @@ recovery path the fabric claims to have can be exercised under load:
                       (``cfg.dispatch_deadline``) must snapshot-then-
                       abort instead of training on through a flaky
                       device or hanging forever.
+- ``kill_replay_shard``   — (sharded replay, ``cfg.replay_shards`` > 1)
+                      SIGKILL a random live replay shard owner process;
+                      the ``replay_watch`` loop must respawn it on its
+                      slot slice and restore it from the latest replay
+                      snapshot (degraded: cold, its slots re-ingest
+                      fresh) — the learner keeps sampling from the
+                      surviving shards throughout.
+- ``garble_sample_response`` — flip bytes in a shard's preassembled
+                      sample-batch response after its CRC32 landed; the
+                      trainer-side verification must catch it and the
+                      bounded retry must re-request (never a torn batch
+                      into the learner).
+- ``stall_shard``   — SIGSTOP a random replay shard for ``dur`` seconds
+                      (then SIGCONT): the sample RPC deadline
+                      (``cfg.replay_sample_timeout``) must fire and the
+                      stalled shard's rows redistribute over the healthy
+                      shards' mass — zero learner stalls.
 
 Spec grammar — semicolon-separated ``kind[:key=val[,key=val...]]``::
 
@@ -64,6 +81,7 @@ from __future__ import annotations
 import logging
 import math
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -74,7 +92,8 @@ log = logging.getLogger(__name__)
 # append new kinds at the END to keep existing soak replays stable
 _KINDS = ("kill_fleet", "garble_block", "truncate_ckpt", "freeze_learner",
           "freeze_service", "drop_act_response", "garble_act_response",
-          "stall_pump", "wedge_dispatch")
+          "stall_pump", "wedge_dispatch", "kill_replay_shard",
+          "garble_sample_response", "stall_shard")
 
 
 def parse_spec(spec: str) -> Dict[str, Dict[str, float]]:
@@ -219,6 +238,58 @@ class ChaosInjector:
         no wedge) — the bounded dispatch-deadline drill."""
         prm = self.fire("wedge_dispatch")
         return float(prm.get("dur", 2.0)) if prm else 0.0
+
+    def maybe_kill_replay_shard(self, plane: Any) -> Optional[int]:
+        """SIGKILL a random live shard of a ShardedReplayPlane — the
+        respawn-with-restore drill.  Returns the killed shard id, or
+        None."""
+        if self.fire("kill_replay_shard") is None:
+            return None
+        live = [s for s, p in enumerate(plane.procs)
+                if p is not None and p.is_alive()]
+        if not live:
+            return None
+        s = int(live[self._rngs["kill_replay_shard"].integers(len(live))])
+        log.warning("chaos: SIGKILL replay shard%d (pid %s)", s,
+                    plane.procs[s].pid)
+        plane.procs[s].kill()
+        return s
+
+    def garble_sample_response(self) -> bool:
+        """One opportunity per received sample-RPC response (the sharded
+        replay plane's receipt path): True = flip response bytes AFTER
+        the shard's CRC landed — trainer-side verification must catch it
+        and the bounded retry must re-request."""
+        return self.fire("garble_sample_response") is not None
+
+    def maybe_stall_shard(self, plane: Any) -> Optional[int]:
+        """SIGSTOP a random live replay shard for ``dur`` seconds, then
+        SIGCONT — the sample-RPC-deadline drill (the caller's thread
+        sleeps through the stall; the shard itself is frozen).  Returns
+        the stalled shard id, or None."""
+        import os
+        import signal as _signal
+
+        prm = self.fire("stall_shard")
+        if prm is None:
+            return None
+        live = [s for s, p in enumerate(plane.procs)
+                if p is not None and p.is_alive()]
+        if not live:
+            return None
+        s = int(live[self._rngs["stall_shard"].integers(len(live))])
+        p = plane.procs[s]
+        dur = float(prm.get("dur", 2.0))
+        log.warning("chaos: SIGSTOP replay shard%d for %.1fs", s, dur)
+        try:
+            os.kill(p.pid, _signal.SIGSTOP)
+            time.sleep(dur)
+        finally:
+            try:
+                os.kill(p.pid, _signal.SIGCONT)
+            except (ProcessLookupError, OSError):
+                pass   # died while stopped: the watchdog takes over
+        return s
 
     def drop_response(self) -> bool:
         """One opportunity per served response token: True = the service

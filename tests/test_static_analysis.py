@@ -59,6 +59,9 @@ def test_repo_tree_is_clean():
         ("r2d2_tpu/parallel/inference_service.py", "telemetry-discipline"),
         # bulk absorption of fixed upstream surfaces (registry.absorb_*)
         ("r2d2_tpu/telemetry/registry.py", "telemetry-discipline"),
+        # bounded measured bench producer thread (stop-event + joined),
+        # same justification as bench.py's measured threads
+        ("tools/replay_bench.py", "thread-discipline"),
     }, suppressed_at
 
 
@@ -433,6 +436,38 @@ def test_wire_format_negative_importing_module_and_non_shm_module():
 
         def checksum(b):
             return zlib.crc32(b) & 0xFFFFFFFF
+    """), rules=["wire-format"])
+    assert report.findings == []
+
+
+def test_wire_format_covers_shard_rpc_shapes():
+    """The sharded replay plane's RPC vocabulary is wire-format-guarded
+    too: a shard-RPC-shaped module redefining ``batch_slot_spec`` (or
+    using it / BATCH_ROW_FIELDS without importing them from
+    replay/block.py) is a finding — the sample-slab layout must have ONE
+    definition or the shard writer and trainer verifier drift."""
+    report = analyze_source(_src("""
+        from multiprocessing import shared_memory
+
+        def batch_slot_spec(cfg, action_dim, batch):
+            return ()
+
+        def take(views):
+            return [views[f] for f in BATCH_ROW_FIELDS]
+    """), rules=["wire-format"])
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "'batch_slot_spec' re-defined" in msgs
+    assert "'BATCH_ROW_FIELDS' used without importing" in msgs
+    # the sanctioned shape — importing both from the wire module — is
+    # clean (this is replay_shards.py's own shape)
+    report = analyze_source(_src("""
+        from multiprocessing import shared_memory
+        from r2d2_tpu.replay.block import (
+            BATCH_ROW_FIELDS, batch_slot_spec, payload_crc32)
+
+        def crc(views, seq, n):
+            return payload_crc32((seq, n),
+                                 [views[f][:n] for f in BATCH_ROW_FIELDS])
     """), rules=["wire-format"])
     assert report.findings == []
 

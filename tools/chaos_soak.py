@@ -11,7 +11,7 @@ replay mass/size match the snapshot meta, the learner state restores,
 and training keeps advancing.  Exit code 1 on any violated invariant.
 
 Run:  python tools/chaos_soak.py [minutes] [--process] [--serve]
-                                 [--anakin] [--out OUT.json]
+                                 [--anakin] [--shards] [--out OUT.json]
 
 ``--process`` soaks the subprocess actor plane (enables the kill_fleet /
 garble_block sites); ``--serve`` additionally routes acting through the
@@ -24,8 +24,15 @@ on top; a round fails if any fleet's circuit is still open at exit or
 if the freeze produced fleet deaths).  ``--anakin`` soaks the fused
 on-device loop with ``wedge_dispatch`` armed against a tight
 ``dispatch_deadline``: wedged rounds must abort cleanly with a
-resumable snapshot, and the next round must come up warm.  Default
-soaks the thread transport (freeze + truncate sites only).
+resumable snapshot, and the next round must come up warm.  ``--shards``
+soaks the SHARDED replay plane (``replay_shards=2``) with
+``kill_replay_shard`` + ``garble_sample_response`` + ``stall_shard``
+armed: every round must finish with zero learner stalls, all shards
+alive (the watchdog respawned every kill), every garbled response
+caught-and-retried, and conserved priority accounting (the plane's
+training-step count equals the learner's updates — no feedback silently
+lost outside the counted cross-respawn drops).  Default soaks the
+thread transport (freeze + truncate sites only).
 """
 import json
 import os
@@ -38,6 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _argv = sys.argv[1:]
 SERVE = "--serve" in _argv
 ANAKIN = "--anakin" in _argv
+SHARDS = "--shards" in _argv
 PROCESS = "--process" in _argv or SERVE
 OUT = None
 if "--out" in _argv:
@@ -85,6 +93,20 @@ def main() -> int:
                          superstep_k=2, anakin_episode_len=12,
                          learning_starts=16)
         extra = dict(dispatch_deadline=0.4)
+    elif SHARDS:
+        # sharded replay plane: shard kill → respawn-with-restore,
+        # response garbling → bounded retry, SIGSTOP stalls → the RPC
+        # deadline redistributes the rows (learner never stalls).  No
+        # truncate_ckpt here: a truncated learner save legitimately
+        # resumes the learner at an earlier step than the plane's
+        # counters, which would trip the accounting invariant for a
+        # reason that has nothing to do with sharding
+        chaos = ("freeze_learner:every=40,dur=0.5"
+                 ";kill_replay_shard:every=200,n=1000000"
+                 ";garble_sample_response:p=0.01"
+                 ";stall_shard:every=350,dur=1.0,n=1000000")
+        transport = dict(actor_transport="thread", num_actors=2)
+        extra = dict(replay_shards=2, replay_sample_timeout=1.0)
     elif PROCESS:
         chaos += ";kill_fleet:every=120;garble_block:p=0.005"
         transport = dict(actor_transport="process", num_actors=2,
@@ -148,6 +170,7 @@ def main() -> int:
                            wedged=m.get("dispatch_wedged"),
                            chaos=m.get("chaos"),
                            fleet=fleet,
+                           replay_shards=m.get("replay_shard_health"),
                            resilience=fleet.get("resilience"),
                            complete_steps=ck.steps(),
                            partial_steps=[s for s in
@@ -163,6 +186,24 @@ def main() -> int:
                 # the point.)
                 if rnd > 1 and not m.get("restored_replay"):
                     failures.append(f"round {rnd}: resume came up cold")
+                if SHARDS:
+                    rh = m.get("replay_shard_health") or {}
+                    if m.get("learner_stalled"):
+                        failures.append(
+                            f"round {rnd}: learner stalled under shard "
+                            "chaos")
+                    if rh.get("alive") != rh.get("shards"):
+                        failures.append(
+                            f"round {rnd}: dead shard at exit "
+                            f"({rh.get('alive')}/{rh.get('shards')})")
+                    # conserved priority accounting: every learner update
+                    # reached the plane's feedback fan-out (cross-respawn
+                    # drops are counted, never silent)
+                    if m.get("buffer_training_steps") != m["num_updates"]:
+                        failures.append(
+                            f"round {rnd}: feedback accounting "
+                            f"{m.get('buffer_training_steps')} != "
+                            f"updates {m['num_updates']}")
                 if ANAKIN and m.get("dispatch_wedged") \
                         and not ck.replay_steps():
                     failures.append(
@@ -203,6 +244,24 @@ def main() -> int:
         if opens and not resyncs:
             failures.append("circuits opened but no re-attach resync "
                             "ever landed")
+    # soak-level invariants (--shards): every shard kill must have been
+    # answered by a watchdog respawn, and armed response garbling must
+    # have been exercised AND caught (garbled_responses only counts
+    # CRC-caught flips — an uncaught one reaches the learner as a torn
+    # batch and fails the round's accounting instead)
+    if SHARDS and rounds:
+        kills = sum((r["chaos"] or {}).get("kill_replay_shard", 0)
+                    for r in rounds)
+        respawns = sum(sum((r.get("replay_shards") or {})
+                           .get("respawns", [])) for r in rounds)
+        garbles = sum((r.get("replay_shards") or {})
+                      .get("garbled_responses", 0) for r in rounds)
+        if kills and respawns < kills:
+            failures.append(f"{kills} shard kills but only {respawns} "
+                            "respawns")
+        if not garbles:
+            failures.append("garble_sample_response armed but no garbled "
+                            "response was ever caught")
     summary = dict(minutes=MINUTES, rounds=len(rounds), failures=failures,
                    final_updates=last_updates,
                    telemetry_jsonl=runlog.path,
