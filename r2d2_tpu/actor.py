@@ -32,6 +32,7 @@ order at the sink becomes nondeterministic across lanes — use
 from __future__ import annotations
 
 import dataclasses
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -41,6 +42,7 @@ import numpy as np
 from r2d2_tpu.config import Config
 from r2d2_tpu.models.network import R2D2Network
 from r2d2_tpu.replay.block import Block, VectorLocalBuffer
+from r2d2_tpu.telemetry.tracing import EVENTS
 from r2d2_tpu.utils.store import ParamStore
 
 # sink(block, priorities, episode_reward_or_None) — direct buffer.add in the
@@ -209,6 +211,10 @@ class VectorActor:
         self.vbuf = VectorLocalBuffer(cfg, self.action_dim, self.N)
         self.episode_steps = np.zeros(self.N, np.int64)
         self.finish_pending = np.zeros(self.N, bool)  # deferred boundary cut
+        # per-lane block start (perf_counter): the cut event's slice spans
+        # the block's whole env-step phase, so "env step → cut" renders as
+        # one slice on this process's trace track (telemetry/tracing.py)
+        self._block_start = np.full(self.N, time.perf_counter())
         self.actor_steps = 0
         self._param_version = 0
         self._params = None
@@ -235,6 +241,7 @@ class VectorActor:
         self.vbuf.reset_lane(i, self.obs[i])
         self.episode_steps[i] = 0
         self.finish_pending[i] = False
+        self._block_start[i] = time.perf_counter()
         if self._act_client is not None:
             self._act_client.note_reset(i)
 
@@ -331,6 +338,22 @@ class VectorActor:
             else:
                 self._reset_lane(i)  # env can't resume: fresh episode
 
+    def _note_cut(self, i: int, block: Block) -> None:
+        """Block-lineage hook at every cut: under an armed capture window
+        (telemetry/tracing.py) the block gets a fabric-unique trace id
+        and the cut emits the lineage flow START — a slice covering the
+        block's env-step phase on this process's track.  Disarmed cost:
+        one attribute check and one clock read per BLOCK (not per
+        step)."""
+        now = time.perf_counter()
+        if EVENTS.armed:
+            block.trace_id = EVENTS.next_trace_id()
+            EVENTS.complete("block.env_steps+cut",
+                            float(self._block_start[i]),
+                            now - float(self._block_start[i]),
+                            flow=block.trace_id, fph="s", arg=i)
+        self._block_start[i] = now
+
     def _step_shard(self, lanes: range, actions: np.ndarray) -> None:
         """Env-step a contiguous lane shard (the only per-lane Python left
         in the hot loop — the gym API is per-env; ALE releases the GIL in
@@ -379,7 +402,9 @@ class VectorActor:
                 # or a snapshot taken now would re-finish an empty lane
                 # at resume
                 self.finish_pending[i] = False
-                self.sink(*self.vbuf.finish(i, q[i]))
+                item = self.vbuf.finish(i, q[i])
+                self._note_cut(i, item[0])
+                self.sink(*item)
 
             explore = self.rng.random(self.N) < self.epsilons
             actions = np.where(explore,
@@ -413,6 +438,7 @@ class VectorActor:
                 # shutdown must leave the lane consistent for the
                 # shutdown snapshot — same ordering as the boundary cut
                 item = self.vbuf.finish(i, None)
+                self._note_cut(i, item[0])
                 self._reset_lane(i)
                 self.sink(*item)
 
@@ -436,6 +462,7 @@ class VectorActor:
                 q_fresh = np.asarray(q_fresh)
                 for i in capped:
                     item = self.vbuf.finish(i, q_fresh[i])
+                    self._note_cut(i, item[0])
                     self._reset_lane(i)  # before the sink; see done_lanes
                     self.sink(*item)
 

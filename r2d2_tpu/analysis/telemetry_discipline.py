@@ -10,9 +10,13 @@ variable part.  It is also an allocation per call in loops the registry
 was specifically designed to keep allocation-light.
 
 The check: every call of a metric-writing method — ``inc``,
-``counter_max``, ``set_gauge``, ``observe``, ``declare_histogram`` on a
-registry-shaped receiver, plus the Tracer surface (``span``, ``gauge``,
-``incr``) — must pass the metric name as a plain string literal.
+``counter_max``, ``set_gauge``, ``observe``, ``observe_many``,
+``declare_histogram`` on a registry-shaped receiver, plus the Tracer
+surface (``span``, ``gauge``, ``incr``) and the cross-process event
+tracer's recording surface (``instant``, ``complete`` —
+telemetry/tracing.py; variable parts go in ``flow``/``arg``, never the
+event name) — must pass the metric/event name as a plain string
+literal.
 Receivers are matched by name shape (``registry`` / ``metrics`` /
 ``telemetry`` / ``tracer`` and ``*.registry`` etc.), the same heuristic
 family as config-integrity's receivers; bulk absorption helpers
@@ -30,12 +34,13 @@ from r2d2_tpu.analysis.core import Context, Finding, rule
 
 RULE = "telemetry-discipline"
 
-# metric-writing methods whose first argument IS a metric name
+# metric-writing methods whose first argument IS a metric/event name
 _METRIC_METHODS = ("inc", "counter_max", "set_gauge", "observe",
-                   "declare_histogram", "span", "gauge", "incr")
+                   "observe_many", "declare_histogram", "span", "gauge",
+                   "incr", "instant", "complete")
 
 _RECEIVER_NAMES = ("registry", "metrics", "telemetry", "tracer", "reg",
-                   "tr")
+                   "tr", "events")
 
 
 def _is_metric_receiver(node: ast.AST) -> bool:
@@ -47,7 +52,7 @@ def _is_metric_receiver(node: ast.AST) -> bool:
     else:
         return False
     return n in _RECEIVER_NAMES or n.endswith(
-        ("registry", "tracer", "_metrics", "telemetry"))
+        ("registry", "tracer", "_metrics", "telemetry", "_events"))
 
 
 def _name_arg(call: ast.Call):

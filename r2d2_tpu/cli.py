@@ -140,6 +140,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "/statusz on 127.0.0.1:PORT (r2d2_tpu/telemetry; "
                          "-1 = ephemeral port, default off); overrides "
                          "cfg.telemetry_port")
+    pt.add_argument("--trace-steps", type=int, default=None, metavar="N",
+                    help="arm one cross-process trace capture at run "
+                         "start covering N train steps; the merged "
+                         "Chrome-trace JSON (Perfetto-loadable) lands "
+                         "under <ckpt-dir>/telemetry/ "
+                         "(telemetry/tracing.py; a live run is captured "
+                         "via GET /tracez?steps=N on the telemetry port "
+                         "instead); overrides cfg.trace_steps")
+    pt.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="capture a jax.profiler device trace of the "
+                         "whole run into DIR (TensorBoard/Perfetto-"
+                         "loadable; utils/trace.device_profile).  For a "
+                         "bounded window on a live run use GET "
+                         "/profilez?secs=S on the telemetry port")
     pt.add_argument("--chaos", default=None, metavar="SPEC",
                     help="fault-injection drill spec (utils/chaos.py), "
                          "e.g. 'kill_fleet:every=500;garble_block:p=0.01' "
@@ -238,6 +252,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cfg = cfg.replace(chaos_spec=args.chaos)
             if args.telemetry_port is not None:
                 cfg = cfg.replace(telemetry_port=args.telemetry_port)
+            if args.trace_steps is not None:
+                cfg = cfg.replace(trace_steps=args.trace_steps)
             if args.act_response_timeout is not None:
                 cfg = cfg.replace(
                     act_response_timeout=args.act_response_timeout)
@@ -250,6 +266,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.sync and args.max_wall_seconds is not None:
             parser.error("--max-wall-seconds is not supported with --sync "
                          "(the deterministic trainer runs to training_steps)")
+        if args.sync and (args.trace_steps or cfg.trace_steps):
+            parser.error("--trace-steps is not supported with --sync "
+                         "(the deterministic trainer runs no telemetry/"
+                         "tracing fabric — no capture could ever dump)")
         if args.distributed:
             from r2d2_tpu.parallel.distributed import init_distributed
 
@@ -264,7 +284,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             use_mesh=args.mesh or args.distributed)
         if not args.sync:
             kwargs.update(max_wall_seconds=args.max_wall_seconds,
-                          verbose=not args.quiet)
+                          verbose=not args.quiet,
+                          profile_dir=args.profile_dir)
+        elif args.profile_dir:
+            parser.error("--profile-dir is not supported with --sync "
+                         "(the deterministic trainer has no device loop "
+                         "worth profiling)")
         metrics = fn(cfg, **kwargs)
         print(json.dumps({k: v for k, v in metrics.items()
                           if isinstance(v, (int, float, str))}))

@@ -413,6 +413,27 @@ class Config:
                                       # before rotation to .1/.2/...
                                       # (append-only either way: resume
                                       # continues the same file)
+    trace_buffer_events: int = 4096   # per-process event-ring capacity of
+                                      # the cross-process tracer
+                                      # (telemetry/tracing.py): each
+                                      # process of the fabric (trainer,
+                                      # fleets, replay shards) owns one
+                                      # preallocated ring of this many
+                                      # fixed-size records; a capture
+                                      # window keeps the newest N (older
+                                      # events overflow, counted in the
+                                      # dump status)
+    trace_steps: int = 0              # >0: arm one cross-process trace
+                                      # capture at run start covering
+                                      # this many train steps, dumped to
+                                      # <ckpt_dir>/telemetry/trace_1.json
+                                      # (Chrome trace JSON — load in
+                                      # Perfetto).  0 (default) records
+                                      # nothing; a live run is captured
+                                      # on demand via the exporter's
+                                      # /tracez endpoint instead
+                                      # (--trace-steps / docs/
+                                      # OBSERVABILITY.md)
     anakin_env_steps_per_update: int = 4  # anakin transport: fused
                                       # env/actor steps per optimizer step
                                       # inside the super-step (the
@@ -584,6 +605,13 @@ class Config:
             raise ValueError("log_history_cap must be >= 1")
         if self.telemetry_log_max_bytes < 1024:
             raise ValueError("telemetry_log_max_bytes must be >= 1024")
+        if self.trace_buffer_events < 64:
+            raise ValueError(
+                "trace_buffer_events must be >= 64 (a capture window "
+                "needs room for at least a few block lifecycles)")
+        if self.trace_steps < 0:
+            raise ValueError("trace_steps must be >= 0 (0 = no boot-time "
+                             "capture; /tracez arms one on demand)")
         if self.chaos_spec:
             # fail at construction, not mid-run: parse_spec raises on an
             # unknown kind/param or a clause without a trigger

@@ -19,6 +19,7 @@ TPU-first redesign:
 """
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -320,10 +321,15 @@ class Learner:
                 updates += 1
                 if (self.param_store is not None
                         and updates % cfg.weight_publish_interval == 0):
-                    self._publish()
+                    # spanned: cadence work is the classic source of
+                    # learner hiccups, and an armed trace capture should
+                    # show a publish/save slice, not an unexplained gap
+                    with tracer.span("learner.publish"):
+                        self._publish()
                 if (self.checkpointer is not None
                         and updates % cfg.save_interval == 0):
-                    self._save(updates, t0)
+                    with tracer.span("learner.checkpoint_save"):
+                        self._save(updates, t0)
             while pending:
                 harvest(pending.popleft())
         finally:
@@ -457,7 +463,8 @@ class Learner:
                 return buffer.sample_meta(k, dispatch=dispatch)
 
         self._superstep_loop(k, target, t0, self._ready_gate(buffer, stop),
-                             sample, harvest, prepare=prepare)
+                             sample, harvest, prepare=prepare,
+                             tracer=tracer)
         return self._finish_device_run(losses_hist, t0)
 
     def _ready_gate(self, buffer, stop):
@@ -656,15 +663,15 @@ class Learner:
             losses_hist.extend(losses_np.tolist())
 
         self._superstep_loop(k, target, t0, gate, sample, harvest,
-                             prepare=prepare)
+                             prepare=prepare, tracer=tracer)
         return self._finish_device_run(losses_hist, t0)
 
     def _superstep_loop(self, k: int, target: int, t0: float,
                         gate: Callable[[], str],
                         sample: Callable[[], Dict[str, Any]],
                         harvest: Callable[[Any], None],
-                        prepare: Optional[Callable[[Any], Any]] = None
-                        ) -> None:
+                        prepare: Optional[Callable[[Any], Any]] = None,
+                        tracer: Optional[Any] = None) -> None:
         """The pipelined super-step driver shared by the single-process
         and multi-host device-replay paths: keep up to
         ``cfg.superstep_pipeline`` dispatches in flight beyond the one
@@ -703,14 +710,18 @@ class Learner:
                 harvest(pending.popleft())
 
             prev, updates = updates, updates + k
+            span = (tracer.span if tracer is not None
+                    else contextlib.nullcontext)
             if (self.param_store is not None
                     and updates // cfg.weight_publish_interval
                     > prev // cfg.weight_publish_interval):
-                self._publish()
+                with span("learner.publish"):
+                    self._publish()
             if (self.checkpointer is not None
                     and updates // cfg.save_interval
                     > prev // cfg.save_interval):
-                self._save(updates, t0)
+                with span("learner.checkpoint_save"):
+                    self._save(updates, t0)
         while pending:
             harvest(pending.popleft())
 
@@ -865,7 +876,7 @@ class Learner:
                                           raw_densities=True)
 
         self._superstep_loop(k, target, t0, gate, sample, harvest,
-                             prepare=prepare)
+                             prepare=prepare, tracer=tracer)
         return self._finish_device_run(losses_hist, t0)
 
     def _save(self, updates: int, t0: float) -> None:

@@ -84,6 +84,7 @@ from r2d2_tpu.telemetry.slab import (
     StatsSlab,
     StatsSlabWriter,
 )
+from r2d2_tpu.telemetry.tracing import EVENTS
 from r2d2_tpu.utils.trace import HOST_TRANSFERS
 
 log = logging.getLogger(__name__)
@@ -214,6 +215,16 @@ class ShmBlockProducer:
         if episode_reward is not None:
             self.episodes += 1
             self.episode_reward_sum += float(episode_reward)
+        # capture-window poll + flush at block granularity: blocks are
+        # the lineage unit (the per-burst poll alone would miss short
+        # windows), and flushing HERE — before the free-slot wait below —
+        # publishes the cut event even when the producer then parks on
+        # channel backpressure through the capture close (the harvest
+        # would otherwise see a stale-CRC slot and drop the whole track).
+        # flush() is a no-op when nothing was recorded since the last one
+        EVENTS.poll()
+        EVENTS.flush()
+        t0 = time.perf_counter()
         while True:
             if self.stop_event.is_set():
                 raise FleetStopped
@@ -227,6 +238,12 @@ class ShmBlockProducer:
         k, n_obs, n_steps = write_block(views, block, priorities)
         self.ready.put((slot, self.src, k, n_obs, n_steps, episode_reward))
         self.blocks_sent += 1
+        if block.trace_id and EVENTS.armed:
+            # lineage hop (armed capture): the slice covers the free-slot
+            # wait + the serialise memcpy, i.e. the channel backpressure
+            EVENTS.complete("fleet.block_send", t0,
+                            time.perf_counter() - t0,
+                            flow=block.trace_id, fph="t")
 
     def close(self) -> None:
         try:
@@ -268,7 +285,7 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                        spec: _FleetSpec, producer_info, weights_q,
                        stop_event, ctrl_q=None, snap_q=None,
                        restore_snap=None, act_info=None,
-                       stats_info=None) -> None:
+                       stats_info=None, trace_info=None) -> None:
     """Entry point of one fleet subprocess.
 
     Pins JAX to the host CPU backend before any backend init (the child
@@ -294,6 +311,11 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
     (telemetry/slab.py): after every run burst the fleet publishes its
     counter vector (env steps, blocks, episodes, weight version) — CRC
     last, no pickling — for the trainer's registry merge.
+
+    ``trace_info`` attaches this process's slot of the cross-process
+    trace slab (telemetry/tracing.py): the fleet polls the fabric-wide
+    capture-window control word and flushes its event ring at the same
+    per-burst cadence as the stats publish.
     """
     import jax
 
@@ -380,9 +402,15 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
                                 src=spec.fleet_id)
     stats_writer = (StatsSlabWriter(stats_info)
                     if stats_info is not None else None)
+    if trace_info is not None:
+        EVENTS.attach(trace_info)
     num_lanes = spec.hi - spec.lo
 
     def publish_stats() -> None:
+        if trace_info is not None:
+            # capture-window poll + ring flush ride the burst cadence
+            EVENTS.poll()
+            EVENTS.flush()
         if stats_writer is None:
             return
         # lockstep fleet: one actor iteration steps every lane
@@ -460,6 +488,9 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
             client.close()
         if stats_writer is not None:
             stats_writer.close()
+        if trace_info is not None:
+            EVENTS.flush()
+            EVENTS.detach()
         producer.close()
 
 
@@ -554,6 +585,12 @@ class ProcessFleetPlane:
         # stall_pump); train() installs the run's injector here and on the
         # service (drop/garble response sites)
         self.chaos = None
+        # cross-process trace slab (telemetry/tracing.py): train() hands
+        # the run's slab + this plane's slot base before start(); each
+        # fleet's worker then records capture-window events into slot
+        # trace_slot_base + f (respawns re-attach incarnation-tagged)
+        self.trace_slab = None
+        self.trace_slot_base = 0
         # param-staleness watchdog: per fleet, when it was FIRST observed
         # running behind the store's newest version.  The timestamp is
         # pinned until the fleet's own version advances (pump alive) or
@@ -726,12 +763,18 @@ class ProcessFleetPlane:
             if not restored:
                 # respawn/cold spawn: no stale recurrent state may survive
                 self.service.reset_shard(f)
+        trace_info = None
+        if self.trace_slab is not None:
+            trace_info = self.trace_slab.writer_info(
+                self.trace_slot_base + f, incarnation=self.restarts[f],
+                name=f"fleet{f}")
         p = self.ctx.Process(
             target=_fleet_worker_main, name=f"fleet{f}",
             args=(self.cfg, self.action_dim, self.env_factory, spec,
                   self.channels[f].producer_info(), self.weight_queues[f],
                   self.stop_event, self.ctrl_queues[f], self.snap_queues[f],
-                  restore_snap, act_info, self.stats_slab.writer_info(f)),
+                  restore_snap, act_info, self.stats_slab.writer_info(f),
+                  trace_info),
             daemon=True)
         p.start()
         self.procs[f] = p
@@ -915,12 +958,24 @@ class ProcessFleetPlane:
             if got is None:
                 continue
             block, prios, episode_reward, slot, src = got
+            t0 = time.perf_counter()
             try:
                 sink(block, prios, episode_reward)
             finally:
                 ch.release(slot)
             self._rr = (f + 1) % F
             frames = block.action.shape[0]
+            # lineage latency decomposition: how long the block sat in
+            # the fleet slab before the trainer consumed it (clock skew
+            # between processes of one host is far below these values)
+            if block.cut_ts > 0:
+                self.registry.observe(
+                    "pipeline.hop.cut_to_ingest_s",
+                    max(0.0, time.time() - block.cut_ts))
+            if block.trace_id and EVENTS.armed:
+                EVENTS.complete("ingest.block", t0,
+                                time.perf_counter() - t0,
+                                flow=block.trace_id, fph="t", arg=src)
             # one shm→ring crossing per block: the hot-loop transfer
             # counter (utils/trace.py) keeps "blocks cross once, never
             # per-field" an assertable invariant

@@ -104,6 +104,7 @@ import numpy as np
 from r2d2_tpu.config import Config
 from r2d2_tpu.parallel.actor_procs import FleetStopped
 from r2d2_tpu.replay.block import payload_crc32, slot_layout, slot_views
+from r2d2_tpu.telemetry.tracing import EVENTS
 from r2d2_tpu.utils.resilience import (
     CLOSED,
     OPEN,
@@ -793,6 +794,12 @@ class InferenceService:
         self.last_batch_lanes = lanes
         if tr is not None:
             tr.gauge("serve.batch_lanes", lanes)
+        if EVENTS.armed:
+            # capture-window marker: one instant per served cross-fleet
+            # batch with the lane count — the assemble/act/scatter spans
+            # above already ride the Tracer→event bridge, this pins the
+            # batch boundary + size on the trainer track
+            EVENTS.instant("serve.batch", arg=lanes)
         return lanes
 
     # --------------------------------------------------------------- misc

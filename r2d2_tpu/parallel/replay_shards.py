@@ -96,6 +96,7 @@ from r2d2_tpu.replay.block import (
 )
 from r2d2_tpu.telemetry.registry import MetricsRegistry
 from r2d2_tpu.telemetry.slab import CounterMerger, StatsSlab, StatsSlabWriter
+from r2d2_tpu.telemetry.tracing import EVENTS
 from r2d2_tpu.utils.resilience import Deadline
 from r2d2_tpu.utils.trace import HOST_TRANSFERS
 
@@ -247,7 +248,7 @@ class _ShardChannels:
 
 def _shard_worker_main(cfg: Config, action_dim: int, shard_id: int,
                        incarnation: int, info: dict, stop_event,
-                       stats_info, restore) -> None:
+                       stats_info, restore, trace_info=None) -> None:
     """Entry point of one replay shard owner process.
 
     ``cfg`` is the already-sliced shard config (``buffer_capacity / K``);
@@ -285,11 +286,19 @@ def _shard_worker_main(cfg: Config, action_dim: int, shard_id: int,
     fb_q, ctrl_q, snap_q = info["fb"], info["ctrl"], info["snap"]
 
     writer = StatsSlabWriter(stats_info, SHARD_STAT_FIELDS)
+    if trace_info is not None:
+        # this process's slot of the cross-process trace slab
+        # (telemetry/tracing.py); armed-window polls and ring flushes
+        # ride the publish cadence below
+        EVENTS.attach(trace_info)
     # session-local counters (start at zero every incarnation, even after
     # a restore — the trainer's CounterMerger folds across respawns)
     counters = dict(blocks=0, corrupt=0, samples=0, prio_updates=0)
 
     def publish() -> None:
+        if trace_info is not None:
+            EVENTS.poll()
+            EVENTS.flush()
         writer.publish(dict(
             tree_mass=buffer.tree.total, size=buffer.size,
             blocks=counters["blocks"],
@@ -355,10 +364,11 @@ def _shard_worker_main(cfg: Config, action_dim: int, shard_id: int,
             ptr, env_steps, served = (buffer.block_ptr, buffer.env_steps,
                                       0)
         else:
-            _, idxes, prios, ptr, env_steps = got
+            _, idxes, prios, ptr, env_steps, ages = got
             served = idxes.shape[0]
             sviews["prios"][:served] = prios
             sviews["idxes"][:served] = idxes
+            sviews["ages"][:served] = ages
         sviews["rsp_n"][0] = served
         sviews["rsp_block_ptr"][0] = ptr
         sviews["rsp_env_steps"][0] = env_steps
@@ -517,6 +527,10 @@ class ShardedReplayPlane:
         # the run's ChaosInjector (train() attaches): the
         # garble_sample_response site fires at response receipt
         self.chaos = None
+        # cross-process trace slab (telemetry/tracing.py): train() hands
+        # the run's slab + this plane's slot base before start()
+        self.trace_slab = None
+        self.trace_slot_base = 0
 
         # plane-side accounting (the ReplayBuffer.stats contract): the
         # coordinator sees every add and every feedback call, so these
@@ -562,11 +576,16 @@ class ShardedReplayPlane:
         self._routed[s] = 0
         self._fb_sent[s] = 0
         self._seq[s] = 0
+        trace_info = None
+        if self.trace_slab is not None:
+            trace_info = self.trace_slab.writer_info(
+                self.trace_slot_base + s, incarnation=self.restarts[s],
+                name=f"shard{s}")
         p = self.ctx.Process(
             target=_shard_worker_main, name=f"replay_shard{s}",
             args=(self.shard_cfg, self.action_dim, s, self.restarts[s],
                   self.channels[s].worker_info(), self.stop_event,
-                  self.stats_slab.writer_info(s), restore),
+                  self.stats_slab.writer_info(s), restore, trace_info),
             daemon=True)
         p.start()
         self.procs[s] = p
@@ -694,6 +713,7 @@ class ShardedReplayPlane:
             self.registry.inc("replay.shard.dropped_blocks",
                               shard=str(s))
             return
+        t0 = time.perf_counter()
         # the send — the bounded free-slot wait AND the multi-MB
         # write_block memcpy — runs OUTSIDE the coordinator lock:
         # holding it here would stall priority feedback and the stats
@@ -724,6 +744,11 @@ class ShardedReplayPlane:
             if episode_reward is not None:
                 self.episode_reward += float(episode_reward)
                 self.num_episodes += 1
+        if block.trace_id and EVENTS.armed:
+            # lineage hop: trainer-side routing into the owning shard's
+            # ingest channel (slice covers the bounded send)
+            EVENTS.complete("replay.route", t0, time.perf_counter() - t0,
+                            flow=block.trace_id, fph="t", arg=s)
 
     def note_corrupt_block(self) -> None:
         """A fleet-channel CRC failure upstream of routing (the
@@ -811,7 +836,7 @@ class ShardedReplayPlane:
         spec = {name: (shape, dtype)
                 for name, shape, dtype in self.channels[0].sample_spec}
         return {name: np.empty((B, *spec[name][0][1:]), spec[name][1])
-                for name in BATCH_ROW_FIELDS}
+                for name in BATCH_ROW_FIELDS + ("ages",)}
 
     def _take_rows(self, s: int, out: Dict[str, np.ndarray],
                    off: int) -> Dict[str, Any]:
@@ -819,7 +844,7 @@ class ShardedReplayPlane:
         ``out`` at row offset ``off``; returns the part's metadata."""
         v = self.channels[s].sample_views
         n = int(v["rsp_n"][0])
-        for name in BATCH_ROW_FIELDS:
+        for name in BATCH_ROW_FIELDS + ("ages",):
             out[name][off:off + n] = v[name][:n]
         return dict(n=n, shard=s, off=off,
                     block_ptr=int(v["rsp_block_ptr"][0]),
@@ -909,6 +934,8 @@ class ShardedReplayPlane:
         lps = self.leaves_per_shard
         rows = {name: out[name] for name in BATCH_ROW_FIELDS
                 if name not in ("prios", "idxes")}
+        rows["ages"] = out["ages"]   # lineage decomposition (shard-side
+        # stamps; the sample loop observes them into pipeline.*)
         prios = out["prios"]
         # global leaf coordinates: shard k owns [k·lps, (k+1)·lps)
         idxes = out["idxes"]

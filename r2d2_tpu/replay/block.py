@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 import zlib
 from typing import List, Optional, Tuple
 
@@ -58,6 +59,14 @@ class Block:
     burn_in_steps: np.ndarray
     learning_steps: np.ndarray
     forward_steps: np.ndarray
+    # block lineage (telemetry/tracing.py, docs/OBSERVABILITY.md):
+    # ``cut_ts`` is the wall-clock time the block was cut (always
+    # stamped — one time.time() per block, which feeds the
+    # pipeline.block_age_at_train_s decomposition); ``trace_id`` is the
+    # nonzero flow id of an armed capture window (0 in steady state —
+    # the capture flag that keeps disarmed overhead at zero)
+    cut_ts: float = 0.0
+    trace_id: int = 0
 
 
 def assemble_block(cfg: Config, *, obs: np.ndarray, last_action: np.ndarray,
@@ -115,6 +124,7 @@ def assemble_block(cfg: Config, *, obs: np.ndarray, last_action: np.ndarray,
         hidden=hiddens, num_sequences=num_sequences,
         burn_in_steps=burn_in, learning_steps=learning,
         forward_steps=forward,
+        cut_ts=time.time(),   # block-lineage birth stamp (Block docstring)
     )
     return block, priorities
 
@@ -147,6 +157,13 @@ def block_slot_spec(cfg: Config, action_dim: int):
                                 "forward_steps"))
     return per_block + windows + (
         ("priorities", (cfg.seqs_per_block,), np.float32),
+        # block lineage (telemetry/tracing.py): the cut wall-clock stamp
+        # (always written — feeds the pipeline.* latency histograms) and
+        # the capture-window flow id (0 when no capture is armed).
+        # Deliberately OUTSIDE the slot CRC: telemetry, not experience —
+        # a garbled stamp must never cost a valid block
+        ("cut_ts", (1,), np.float64),
+        ("trace_id", (1,), np.int64),
         # integrity word: CRC32 over the slot's used payload bytes + the
         # shape header, written LAST by the producer.  A torn write (a
         # producer SIGKILLed mid-slot) or garbled slab shows up as a
@@ -195,6 +212,11 @@ def batch_slot_spec(cfg: Config, action_dim: int, batch_size: int):
         ("forward", (B,), np.int32),
         ("prios", (B,), np.float64),
         ("idxes", (B,), np.int64),
+        # block-lineage ages per served row (seconds since cut / since
+        # ring add, measured shard-side at gather time — the shard owns
+        # the stamps; telemetry/tracing.py).  Outside BATCH_ROW_FIELDS,
+        # hence outside the response CRC: telemetry, not experience
+        ("ages", (B, 2), np.float32),
         ("req_n", (1,), np.int64),
         ("req_seq", (1,), np.int64),
         ("req_crc", (1,), np.uint32),
@@ -302,6 +324,10 @@ def write_block(views: dict, block: Block, priorities: np.ndarray
     views["learning_steps"][:k] = block.learning_steps
     views["forward_steps"][:k] = block.forward_steps
     views["priorities"][:] = priorities
+    # lineage stamps travel outside the CRC (block_slot_spec) — always
+    # written so a recycled slot can never leak its previous block's id
+    views["cut_ts"][0] = block.cut_ts
+    views["trace_id"][0] = block.trace_id
     # CRC last: a slot is only valid once its integrity word matches
     views["crc32"][0] = slot_crc(views, k, n_obs, n_steps)
     return k, n_obs, n_steps
@@ -325,6 +351,8 @@ def read_block(views: dict, k: int, n_obs: int, n_steps: int
         burn_in_steps=views["burn_in_steps"][:k],
         learning_steps=views["learning_steps"][:k],
         forward_steps=views["forward_steps"][:k],
+        cut_ts=float(views["cut_ts"][0]),
+        trace_id=int(views["trace_id"][0]),
     )
     return block, views["priorities"]
 

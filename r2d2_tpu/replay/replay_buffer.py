@@ -17,6 +17,7 @@ whole batch cost, which is what lets the host feed a TPU-rate learner.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -24,6 +25,28 @@ import numpy as np
 from r2d2_tpu.config import Config
 from r2d2_tpu.replay.block import Block, slot_layout, slot_views
 from r2d2_tpu.replay.sum_tree import SumTree
+from r2d2_tpu.telemetry.tracing import EVENTS
+
+# at most this many lineage flow points per sampled batch / feedback
+# call: a B=64 batch touching 64 distinct blocks must not dump 64 flow
+# records into the ring per draw — a few complete chains per capture is
+# what the timeline needs
+_FLOW_CAP = 8
+
+
+def _emit_flows(name: str, trace_ids: np.ndarray, fph: str) -> None:
+    """Flow points for the distinct nonzero capture-window trace ids in
+    ``trace_ids`` (capped) — no-op unless a capture is armed."""
+    if not EVENTS.armed:
+        return
+    seen = 0
+    for tid in np.unique(trace_ids):
+        if tid == 0:
+            continue
+        EVENTS.instant(name, flow=int(tid), fph=fph)  # graftlint: disable=telemetry-discipline -- pass-through helper; call sites pass literal names
+        seen += 1
+        if seen >= _FLOW_CAP:
+            break
 
 
 def _data_spec(cfg: Config, action_dim: int):
@@ -165,6 +188,16 @@ class ReplayBuffer:
         self.tree = SumTree(cfg.num_sequences, cfg.prio_exponent,
                             cfg.importance_sampling_exponent, rng=rng)
 
+        # block-lineage sidecar (telemetry/tracing.py): per PHYSICAL slot,
+        # the resident block's cut/add wall-clock stamps (feed the
+        # pipeline.block_age_at_train_s / pipeline.hop.* histograms) and
+        # its capture-window trace id (0 in steady state).  Deliberately
+        # NOT part of the snapshot layout: after a restore the stamps are
+        # zero and age observation skips those slots.
+        self._slot_cut_ts = np.zeros(cfg.num_blocks)
+        self._slot_add_ts = np.zeros(cfg.num_blocks)
+        self._slot_trace = np.zeros(cfg.num_blocks, np.int64)
+
         self.lock = threading.Lock()
         self.block_ptr = 0
         self.size = 0            # total learning steps stored (reference "size")
@@ -290,9 +323,18 @@ class ReplayBuffer:
             self.env_steps += total
 
             self.block_ptr = (ptr + 1) % cfg.num_blocks
+            self._slot_cut_ts[slot] = block.cut_ts
+            self._slot_add_ts[slot] = time.time()
+            self._slot_trace[slot] = block.trace_id
             if episode_reward is not None:
                 self.episode_reward += episode_reward
                 self.num_episodes += 1
+        if block.trace_id:
+            # lineage hop (armed capture only): the block landed in a ring
+            # — the same event whether this buffer is the K=1 in-process
+            # ring or a shard owner process's slice
+            _emit_flows("replay.add_block", np.array([block.trace_id]),
+                        "t")
 
     # --------------------------------------------------------------- sample
     def sample_batch(self, batch_size: Optional[int] = None) -> Dict[str, np.ndarray]:
@@ -322,8 +364,27 @@ class ReplayBuffer:
                 idxes=idxes,
                 block_ptr=self.block_ptr,
                 env_steps=self.env_steps,
+                ages=self._row_ages(idxes),
             )
+        if EVENTS.armed:
+            _emit_flows("replay.sample",
+                        self._slot_trace[idxes // cfg.seqs_per_block], "t")
         return batch
+
+    def _row_ages(self, idxes: np.ndarray) -> np.ndarray:
+        """(n, 2) float32 per-row block ages at gather time — seconds
+        since the block was cut (column 0: the end-to-end freshness the
+        learner trains on) and since it landed in this ring (column 1:
+        the replay-residency hop).  Rows whose slot has no stamp (a
+        restored snapshot — the sidecar is not persisted) carry -1 and
+        the observers skip them.  Caller holds the lock."""
+        slots = idxes // self.cfg.seqs_per_block
+        now = time.time()
+        cut, add = self._slot_cut_ts[slots], self._slot_add_ts[slots]
+        ages = np.empty((idxes.shape[0], 2), np.float32)
+        ages[:, 0] = np.where(cut > 0, np.maximum(0.0, now - cut), -1.0)
+        ages[:, 1] = np.where(add > 0, np.maximum(0.0, now - add), -1.0)
+        return ages
 
     def _gather_rows(self, idxes: np.ndarray,
                      out: Optional[Dict[str, np.ndarray]] = None
@@ -416,7 +477,10 @@ class ReplayBuffer:
         local FIFO pointer, which the shard's own
         :meth:`update_priorities` stale-mask needs at feedback time.
         ``out``: response-slab destination views (already sliced to
-        ``n`` rows) the gather writes straight into."""
+        ``n`` rows) the gather writes straight into.  The trailing
+        ``ages`` element is the :meth:`_row_ages` lineage decomposition
+        the trainer-side coordinator feeds into the ``pipeline.*``
+        histograms (the shard process has no registry of its own)."""
         with self.lock:
             if self.size == 0 or self.tree.total <= 0:
                 # the coordinator's mass vector can be one publish stale —
@@ -425,7 +489,12 @@ class ReplayBuffer:
                 return None
             idxes, prios = self.tree.sample(n, raw=True)
             rows = self._gather_rows(idxes, out=out)
-            return rows, idxes, prios, self.block_ptr, self.env_steps
+            ages = self._row_ages(idxes)
+        if EVENTS.armed:
+            _emit_flows("replay.sample",
+                        self._slot_trace[idxes // self.cfg.seqs_per_block],
+                        "t")
+        return rows, idxes, prios, self.block_ptr, self.env_steps, ages
 
     # ---------------------------------------------------------- sample (meta)
     def sample_meta(self, k: int, batch_size: Optional[int] = None,
@@ -558,6 +627,12 @@ class ReplayBuffer:
             self.tree.update(idxes[mask], priorities[mask])
             self.training_steps += 1
             self.sum_loss += float(loss)
+            traces = (self._slot_trace[idxes[mask] // K]
+                      if EVENTS.armed and mask.any() else None)
+        if traces is not None:
+            # lineage terminus (armed capture only): priority feedback
+            # landed back on the owning ring — the end of the flow chain
+            _emit_flows("replay.priority_feedback", traces, "f")
 
     def note_corrupt_block(self) -> None:
         """A wire-format integrity check failed and the block was dropped

@@ -16,6 +16,13 @@ The observability subsystem (docs/OBSERVABILITY.md):
   (``cfg.telemetry_port`` / ``--telemetry-port``).
 - :mod:`~r2d2_tpu.telemetry.console` — the one console rendering shared
   by ``train()``'s verbose line and ``tools/r2d2_top.py``.
+- :mod:`~r2d2_tpu.telemetry.tracing` — cross-process structured event
+  tracing: per-process preallocated shm event rings, fabric-wide
+  bounded capture windows (``/tracez`` / ``--trace-steps``), block
+  lineage flows, and the merged Chrome-trace (Perfetto) dump.
+  Deliberately NOT re-exported here: instrumented code imports the
+  module directly so the :data:`~r2d2_tpu.telemetry.tracing.EVENTS`
+  singleton's attach-in-place semantics stay unambiguous.
 - :mod:`~r2d2_tpu.telemetry.plane` — the per-run orchestrator
   (``Telemetry``) that ``train()`` wires through the fabric.
 """

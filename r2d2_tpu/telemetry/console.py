@@ -30,6 +30,15 @@ def format_entry(entry: Dict[str, Any], prefix: str = "[r2d2]") -> str:
         totals = stats.get("totals") or {}
         if totals.get("env_steps"):
             line += f" fleet_env_steps={int(totals['env_steps'])}"
+    trace = entry.get("trace") or {}
+    p95 = trace.get("span.learner.step_dispatch.p95_ms")
+    if p95 is not None:
+        # span-histogram percentiles (utils/trace.Tracer): the learner's
+        # dispatch latency tail, visible without a trace dump
+        line += f" step_p95={p95:.1f}ms"
+        wait95 = trace.get("span.learner.batch_wait.p95_ms")
+        if wait95 is not None:
+            line += f" wait_p95={wait95:.1f}ms"
     rs = entry.get("replay_shards")
     if rs:
         line += f" shards={rs.get('alive', 0)}/{rs.get('shards', 0)}"

@@ -12,6 +12,13 @@ Three endpoint contracts, chosen so stock tooling works unmodified:
 - ``GET /statusz`` — full JSON snapshot (registry dump + health + the
   newest log entry): the machine-readable twin of the terminal view.
 
+Trigger routes (``routes=``): the caller may register extra GET paths —
+``train()`` wires ``/tracez`` (arm a bounded cross-process trace
+capture; dump under ``<ckpt_dir>/telemetry/``) and ``/profilez`` (arm a
+``jax.profiler`` device trace) through this hook
+(docs/OBSERVABILITY.md §Tracing).  A route handler receives the flat
+query-param dict and returns ``(status_code, json_payload)``.
+
 Anything else is 404.  The server binds loopback by default and is
 driven by the caller's loop (:meth:`handle_once` — a bounded
 ``handle_request`` with the server timeout set), so in ``train()`` it
@@ -28,7 +35,11 @@ from __future__ import annotations
 
 import json
 from http.server import BaseHTTPRequestHandler, HTTPServer
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qsl
+
+# a trigger route: flat query params in, (status code, JSON payload) out
+RouteFn = Callable[[Dict[str, str]], Tuple[int, Dict[str, Any]]]
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 JSON_CONTENT_TYPE = "application/json; charset=utf-8"
@@ -39,10 +50,12 @@ class TelemetryExporter:
 
     def __init__(self, registry, health_fn: Callable[[], Dict[str, Any]],
                  status_fn: Optional[Callable[[], Dict[str, Any]]] = None,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 routes: Optional[Dict[str, RouteFn]] = None):
         self.registry = registry
         self.health_fn = health_fn
         self.status_fn = status_fn
+        self.routes = dict(routes or {})
         exporter = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -63,8 +76,15 @@ class TelemetryExporter:
 
     # ------------------------------------------------------------ serving
     def _respond(self, handler: BaseHTTPRequestHandler) -> None:
-        path = handler.path.split("?", 1)[0]
-        if path == "/metrics":
+        path, _, query = handler.path.partition("?")
+        if path in self.routes:
+            try:
+                code, payload = self.routes[path](dict(parse_qsl(query)))
+            except Exception as e:   # a trigger must never kill the loop
+                code, payload = 500, dict(error=str(e))
+            self._send(handler, code, JSON_CONTENT_TYPE,
+                       json.dumps(payload, default=str).encode("utf-8"))
+        elif path == "/metrics":
             body = self.registry.render_prometheus().encode("utf-8")
             self._send(handler, 200, PROM_CONTENT_TYPE, body)
         elif path == "/healthz":
@@ -105,12 +125,13 @@ class TelemetryExporter:
         self.server.server_close()
 
 
-def make_exporter(cfg, registry, health_fn,
-                  status_fn=None) -> Optional[TelemetryExporter]:
+def make_exporter(cfg, registry, health_fn, status_fn=None,
+                  routes=None) -> Optional[TelemetryExporter]:
     """The config gate: ``telemetry_port == 0`` → disabled (None);
     ``> 0`` → that port; ``-1`` → ephemeral (the bound port is on the
     returned exporter)."""
     if cfg.telemetry_port == 0:
         return None
     return TelemetryExporter(registry, health_fn, status_fn=status_fn,
-                             port=max(0, cfg.telemetry_port))
+                             port=max(0, cfg.telemetry_port),
+                             routes=routes)

@@ -49,12 +49,15 @@ class Telemetry:
         self.last_entry: Dict[str, Any] = {}
 
     # ------------------------------------------------------------ exporter
-    def serve(self, health_fn) -> Optional[TelemetryExporter]:
+    def serve(self, health_fn, routes=None) -> Optional[TelemetryExporter]:
         """Arm the HTTP exporter per ``cfg.telemetry_port`` (None when
-        disabled).  ``/statusz`` carries the newest recorded entry."""
+        disabled).  ``/statusz`` carries the newest recorded entry;
+        ``routes`` adds trigger endpoints (``/tracez``/``/profilez`` —
+        exporter module docstring)."""
         self.exporter = make_exporter(
             self.cfg, self.registry, health_fn,
-            status_fn=lambda: dict(last_entry=self.last_entry))
+            status_fn=lambda: dict(last_entry=self.last_entry),
+            routes=routes)
         if self.exporter is not None:
             self._bound_port = self.exporter.port
         return self.exporter

@@ -120,6 +120,28 @@ class MetricsRegistry:
                     self._hist_bounds.get(name, DEFAULT_BUCKETS))
             h.observe(float(value))
 
+    def observe_many(self, name: str, values, **labels) -> None:
+        """Vectorised :meth:`observe` for a batch of samples (e.g. the
+        per-row block ages of one sampled batch): one lock acquisition
+        and one ``np.searchsorted`` pass instead of ``len(values)``
+        locked bisects."""
+        import numpy as np
+
+        values = np.asarray(values, np.float64).ravel()
+        if values.size == 0:
+            return
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = _Histogram(
+                    self._hist_bounds.get(name, DEFAULT_BUCKETS))
+            idx = np.searchsorted(h.bounds, values, side="left")
+            for i, c in zip(*np.unique(idx, return_counts=True)):
+                h.counts[int(i)] += int(c)
+            h.total += float(values.sum())
+            h.count += int(values.size)
+
     # bulk absorption of the pre-existing flat-dict surfaces ---------------
     def absorb_gauges(self, prefix: str,
                       mapping: Mapping[str, float], **labels) -> None:

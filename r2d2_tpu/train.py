@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import queue
 import signal
 import threading
@@ -352,7 +353,15 @@ class _HostScaffold:
                  signal_msg: str = "draining fabric, then saving full state",
                  watch_label: str = "learner"):
         self.cfg = cfg
+        self.checkpoint_dir = checkpoint_dir
         self.telemetry = Telemetry(cfg, checkpoint_dir)
+        # on-demand capture plane (telemetry/tracing.py), armed by
+        # tracing_loops(); exporter_loops() then exposes its /tracez +
+        # /profilez trigger routes
+        self.trace_slab = None
+        self.trace_ctl = None
+        self.profile_ctl = None
+        self.trace_routes: Dict[str, Any] = {}
         # a thread exhausting its restart budget is stamped straight into
         # the registry by the supervisor itself — the log loop (the usual
         # absorption path) may be the very thread that died
@@ -422,13 +431,77 @@ class _HostScaffold:
         return ([("learner_watch", self._learner_watch)]
                 if self.cfg.learner_stall_timeout > 0 else [])
 
+    def _telemetry_dir(self) -> str:
+        """Where trace/profile dumps land: next to the JSONL run log, or
+        a one-shot temp dir for checkpoint-less runs."""
+        if self.checkpoint_dir:
+            return os.path.join(self.checkpoint_dir, "telemetry")
+        if not hasattr(self, "_tmp_telemetry_dir"):
+            import tempfile
+
+            self._tmp_telemetry_dir = tempfile.mkdtemp(
+                prefix="r2d2_telemetry_")
+        return self._tmp_telemetry_dir
+
+    def tracing_loops(self, num_slots: int,
+                      step_fn: Callable[[], int]) -> List[Any]:
+        """Build the run's cross-process trace slab (one event-ring slot
+        per fabric process — trainer + fleets + replay shards), attach
+        the process-wide recorder to slot 0, arm the capture controllers
+        (``/tracez`` trace windows, ``/profilez`` device profiles,
+        ``cfg.trace_steps`` boot-time capture), and return the
+        supervised capture loop.  Call BEFORE :meth:`exporter_loops` so
+        the trigger routes are registered on the exporter."""
+        from r2d2_tpu.telemetry.tracing import (
+            EVENTS,
+            ProfileController,
+            TraceController,
+            TraceSlab,
+        )
+
+        cfg = self.cfg
+        self.trace_slab = TraceSlab(num_slots, cfg.trace_buffer_events)
+        EVENTS.attach(self.trace_slab.writer_info(0, 0, "trainer"))
+        out_dir = self._telemetry_dir()
+        self.trace_ctl = TraceController(self.trace_slab, step_fn, out_dir,
+                                         tracer=EVENTS)
+        self.profile_ctl = ProfileController(out_dir)
+
+        def tracez(params: Dict[str, str]):
+            if "steps" in params:
+                res = self.trace_ctl.arm(int(params["steps"]))
+                return (409 if "error" in res else 200), res
+            return 200, self.trace_ctl.status()
+
+        def profilez(params: Dict[str, str]):
+            if "secs" in params:
+                res = self.profile_ctl.arm(float(params["secs"]))
+                return (409 if "error" in res else 200), res
+            return 200, self.profile_ctl.status()
+
+        self.trace_routes = {"/tracez": tracez, "/profilez": profilez}
+        if cfg.trace_steps > 0:
+            self.trace_ctl.arm(cfg.trace_steps)
+
+        def capture_loop():
+            while not self.stop():
+                self.trace_ctl.poll()
+                self.profile_ctl.poll()
+                EVENTS.flush()       # trainer ring publishes like any
+                time.sleep(0.1)      # other writer's cadence
+            # a window still open at shutdown (short run, stop mid-
+            # capture) is force-closed so its dump is never lost
+            self.trace_ctl.poll(force=True)
+
+        return [("capture", capture_loop)]
+
     def exporter_loops(self, healthz: Callable[[], Dict[str, Any]]
                        ) -> List[Any]:
         """Arm the HTTP exporter around the trainer's healthz verdict.
         The loop is close-driven, NOT stop-driven: a stalled/stopping run
         must stay scrapeable (that is when /healthz matters most); quiesce
         closes the exporter before joining it."""
-        exporter = self.telemetry.serve(healthz)
+        exporter = self.telemetry.serve(healthz, routes=self.trace_routes)
         if exporter is None:    # telemetry_port == 0
             return []
 
@@ -455,6 +528,13 @@ class _HostScaffold:
 
     def close(self) -> None:
         self.telemetry.close()
+        if self.trace_slab is not None:
+            # after the planes' shutdown (train's finally order): every
+            # subprocess writer is gone, so the unlink is safe
+            from r2d2_tpu.telemetry.tracing import EVENTS
+
+            EVENTS.detach()
+            self.trace_slab.close()
         for sig, handler in self._prev_handlers.items():
             try:
                 signal.signal(sig, handler)
@@ -701,7 +781,12 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
         except Exception as e:  # never fail the run over snapshot I/O
             log.warning("anakin full-state snapshot failed: %s", e)
 
+    # tracing: the fused loop is one process, so the capture plane is a
+    # single-slot slab — trainer-track spans (dispatch/result-sync) and
+    # the /tracez + /profilez triggers work unchanged; block lineage
+    # does not exist here (blocks never leave the device)
     loops = ([("log", log_loop)] + scaffold.watch_loops()
+             + scaffold.tracing_loops(1, lambda: plane.training_steps)
              + scaffold.exporter_loops(healthz))
 
     try:
@@ -840,6 +925,24 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         chaos = ChaosInjector(cfg.chaos_spec, seed=cfg.seed)
         if checkpointer is not None:
             checkpointer.chaos = chaos
+    # cross-process tracing (telemetry/tracing.py): one event-ring slot
+    # per fabric process — trainer (slot 0) + fleets + replay shards —
+    # armed fabric-wide by /tracez, --trace-steps, or chaos_soak's
+    # --trace round.  Built before the planes spawn so every worker
+    # attaches at birth.
+    num_trace_slots = (1 + (plane.num_fleets if plane is not None else 0)
+                       + (replay_plane.K if replay_plane is not None
+                          else 0))
+    tracing_loops = scaffold.tracing_loops(
+        num_trace_slots, lambda: buffer.training_steps)
+    if plane is not None:
+        plane.trace_slab = scaffold.trace_slab
+        plane.trace_slot_base = 1
+    if replay_plane is not None:
+        replay_plane.trace_slab = scaffold.trace_slab
+        replay_plane.trace_slot_base = 1 + (plane.num_fleets
+                                            if plane is not None else 0)
+
     if plane is not None:
         # CRC-failed blocks dropped at ingest surface in buffer.stats()
         plane.on_corrupt = buffer.note_corrupt_block
@@ -887,6 +990,11 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     batch_queue: "queue.Queue" = queue.Queue(maxsize=8)
     priority_queue: "queue.Queue" = queue.Queue(maxsize=8)
+    # sample→feedback latency pairing: batches and their priority
+    # feedback move through FIFO queues in order, so a deque of enqueue
+    # stamps pairs each feedback with its batch without widening the
+    # priority-sink signature (bounded: a drained stop drops stragglers)
+    sample_ts: collections.deque = collections.deque(maxlen=64)
 
     def make_actor_loop(a: VectorActor):
         def actor_loop():
@@ -896,6 +1004,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         return actor_loop
 
     def sample_loop():
+        registry = telemetry.registry
         while not stop():
             if not buffer.ready:
                 time.sleep(0.05)
@@ -910,20 +1019,44 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                         continue
                 else:
                     batch = buffer.sample_batch(sys["host_bs"])
+            # block-lineage latency decomposition (docs/OBSERVABILITY.md):
+            # per-row ages stamped where the data lives (the K=1 ring or
+            # the shard process), observed here where the registry lives.
+            # Measured at batch assembly — the learner consumes within
+            # the bounded staging window (queue 8 + prefetch), which is
+            # the train-time envelope the histogram name promises.
+            ages = batch.pop("ages", None)
+            if ages is not None:
+                ages = np.asarray(ages)
+                cut, add = ages[:, 0], ages[:, 1]
+                registry.observe_many("pipeline.block_age_at_train_s",
+                                      cut[cut >= 0])
+                registry.observe_many("pipeline.hop.ingest_to_sample_s",
+                                      add[add >= 0])
             while not stop():
                 try:
                     batch_queue.put(batch, timeout=0.1)
+                    sample_ts.append(time.perf_counter())
                     break
                 except queue.Full:
                     continue
 
     def priority_loop():
+        registry = telemetry.registry
         while not stop():
             try:
                 idxes, priorities, old_ptr, loss = priority_queue.get(
                     timeout=0.1)
             except queue.Empty:
                 continue
+            if sample_ts:
+                # FIFO pairing with the batch this feedback came from
+                try:
+                    registry.observe(
+                        "pipeline.hop.sample_to_feedback_s",
+                        time.perf_counter() - sample_ts.popleft())
+                except IndexError:
+                    pass   # raced the deque's bound — skip the sample
             with tracer.span("buffer.update_priorities"):
                 buffer.update_priorities(idxes, priorities, old_ptr, loss)
 
@@ -1070,6 +1203,7 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         loops += replay_plane.make_loops(stop)
     loops += [("sample", sample_loop), ("priority", priority_loop),
               ("log", log_loop)]
+    loops += tracing_loops
     loops += scaffold.exporter_loops(healthz)
     if sys["ring"] is not None:
         # device replay: the learner samples index bundles itself (cheap,

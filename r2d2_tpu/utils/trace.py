@@ -7,9 +7,12 @@ the survey calls for:
 - :class:`Tracer` — in-process stage timers and gauges.  Spans record
   wall-time per pipeline stage (actor inference, batch assembly, H2D
   staging, learner step, priority feedback) as exponential moving averages
-  with counts; gauges record instantaneous values (queue depths, buffer
-  fill).  A ``snapshot()`` is a plain dict, cheap enough to attach to every
-  log line.
+  with counts AND a fixed log-bucket histogram per span (p50/p95/p99
+  surfaced in ``snapshot()``, hence /statusz and the console line).  A
+  ``snapshot()`` is a plain dict, cheap enough to attach to every log
+  line.  Each span call site also doubles as a structured trace event
+  whenever a capture window is armed (telemetry/tracing.py — the
+  cross-process Perfetto timeline).
 - :func:`device_profile` — a context manager around ``jax.profiler`` trace
   capture, producing a TensorBoard-loadable trace of the XLA device
   timeline for any region of the training loop.
@@ -33,20 +36,27 @@ sit in the hot loop.
 """
 from __future__ import annotations
 
+import bisect
 import contextlib
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
+# fixed log-spaced span-duration buckets (seconds, 4 per decade from
+# 10 µs to 100 s): every span shares them, so the per-update cost is one
+# bisect + one int increment and the percentile read needs no samples
+_SPAN_BOUNDS = tuple(10.0 ** (e / 4.0) for e in range(-20, 9))
+
 
 class _Stat:
-    __slots__ = ("count", "total", "ewma", "last")
+    __slots__ = ("count", "total", "ewma", "last", "buckets")
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
         self.ewma = 0.0
         self.last = 0.0
+        self.buckets = [0] * (len(_SPAN_BOUNDS) + 1)
 
     def update(self, dt: float, alpha: float) -> None:
         self.count += 1
@@ -54,6 +64,25 @@ class _Stat:
         self.last = dt
         self.ewma = dt if self.count == 1 else (
             alpha * dt + (1.0 - alpha) * self.ewma)
+        self.buckets[bisect.bisect_left(_SPAN_BOUNDS, dt)] += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate quantile from the fixed buckets: linear
+        interpolation inside the bucket the rank lands in (the +Inf
+        bucket answers its finite lower edge — conservative)."""
+        rank = q * self.count
+        cum = 0
+        for i, c in enumerate(self.buckets):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = _SPAN_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = (_SPAN_BOUNDS[i] if i < len(_SPAN_BOUNDS)
+                      else _SPAN_BOUNDS[-1])
+                frac = min(1.0, max(0.0, (rank - cum) / c))
+                return lo + (hi - lo) * frac
+            cum += c
+        return 0.0
 
 
 class Tracer:
@@ -66,12 +95,21 @@ class Tracer:
     >>> tracer.snapshot()["span.learner_step.ewma_ms"]
     """
 
-    def __init__(self, alpha: float = 0.05):
+    def __init__(self, alpha: float = 0.05, events=None):
         self._alpha = alpha
         self._spans: Dict[str, _Stat] = {}
         self._gauges: Dict[str, float] = {}
         self._counters: Dict[str, int] = {}
         self._lock = threading.Lock()
+        if events is None:
+            # the process-wide structured event recorder
+            # (telemetry/tracing.py): every span call site doubles as a
+            # Chrome-trace slice whenever a capture window is armed —
+            # zero extra instrumentation in the stage code
+            from r2d2_tpu.telemetry.tracing import EVENTS
+
+            events = EVENTS
+        self._event_sink = events
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
@@ -85,6 +123,11 @@ class Tracer:
                 if stat is None:
                     stat = self._spans[name] = _Stat()
                 stat.update(dt, self._alpha)
+            events = self._event_sink
+            if events is not None and events.armed:
+                # pass-through into the armed capture window; every
+                # call site above passes a literal name
+                events.complete(name, t0, dt)  # graftlint: disable=telemetry-discipline -- pass-through bridge; span() call sites pass literal names
 
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -95,14 +138,20 @@ class Tracer:
             self._counters[name] = self._counters.get(name, 0) + by
 
     def snapshot(self) -> Dict[str, float]:
-        """Flat dict: span.<name>.{ewma_ms,mean_ms,count}, gauge.<name>,
-        counter.<name>."""
+        """Flat dict: span.<name>.{ewma_ms,mean_ms,count,p50_ms,p95_ms,
+        p99_ms}, gauge.<name>, counter.<name>.  The percentiles come
+        from each span's fixed log-bucket histogram — visible per log
+        interval in /statusz and the console line without a trace
+        dump."""
         out: Dict[str, float] = {}
         with self._lock:
             for name, s in self._spans.items():
                 out[f"span.{name}.ewma_ms"] = s.ewma * 1e3
                 out[f"span.{name}.mean_ms"] = (s.total / s.count) * 1e3
                 out[f"span.{name}.count"] = s.count
+                out[f"span.{name}.p50_ms"] = s.percentile(0.50) * 1e3
+                out[f"span.{name}.p95_ms"] = s.percentile(0.95) * 1e3
+                out[f"span.{name}.p99_ms"] = s.percentile(0.99) * 1e3
             for name, v in self._gauges.items():
                 out[f"gauge.{name}"] = v
             for name, v in self._counters.items():

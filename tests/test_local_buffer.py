@@ -154,6 +154,11 @@ def test_stored_hidden_mode_seq_start_matches_reference_indexing():
 def _assert_blocks_equal(b1, b2):
     import dataclasses as dc
     for f in dc.fields(b1):
+        if f.name in ("cut_ts", "trace_id"):
+            # lineage telemetry stamps (telemetry/tracing.py), not
+            # experience: two buffers cutting the same block at
+            # different wall instants legitimately differ here
+            continue
         v1, v2 = getattr(b1, f.name), getattr(b2, f.name)
         if isinstance(v1, np.ndarray):
             np.testing.assert_array_equal(v1, v2, err_msg=f.name)

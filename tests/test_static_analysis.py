@@ -57,6 +57,11 @@ def test_repo_tree_is_clean():
         ("r2d2_tpu/parallel/actor_procs.py", "thread-discipline"),
         # nullable-tracer pass-through helper; call sites pass literals
         ("r2d2_tpu/parallel/inference_service.py", "telemetry-discipline"),
+        # lineage flow-point pass-through helper; call sites pass literals
+        ("r2d2_tpu/replay/replay_buffer.py", "telemetry-discipline"),
+        # the Tracer.span -> event-tracer bridge forwards the span's
+        # literal name into an armed capture window
+        ("r2d2_tpu/utils/trace.py", "telemetry-discipline"),
         # bulk absorption of fixed upstream surfaces (registry.absorb_*)
         ("r2d2_tpu/telemetry/registry.py", "telemetry-discipline"),
         # bounded measured bench producer thread (stop-event + joined),
@@ -508,6 +513,28 @@ def test_telemetry_discipline_negative_literals_labels_and_receivers():
             registry.declare_histogram("lat", [1, 2, 4])
             some_set.observe(f"not.{a}.metric")   # not a registry shape
             obj.inc(f"free.{x}")                  # nor this
+    """), rules=["telemetry-discipline"])
+    assert report.findings == []
+
+
+def test_telemetry_discipline_covers_tracing_api():
+    """The cross-process event tracer (telemetry/tracing.py) is part of
+    the telemetry namespace: event names must be literals too — the
+    variable part belongs in ``flow``/``arg``, and an f-string name
+    would mint unbounded Perfetto slice names per entity."""
+    report = analyze_source(_src("""
+        def hot_loop(src, tid):
+            EVENTS.instant(f"ingest.{src}", flow=tid)
+            EVENTS.complete(make_name(src), t0, 0.1)
+            self._events.instant(f"hop.{src}")
+    """), rules=["telemetry-discipline"])
+    assert len(report.findings) == 3
+    report = analyze_source(_src("""
+        def hot_loop(src, tid):
+            EVENTS.instant("ingest.block", flow=tid, arg=src)
+            EVENTS.complete("fleet.block_send", t0, 0.1, flow=tid)
+            registry.observe_many("pipeline.block_age_at_train_s", ages)
+            fut.complete(f"not.a.{tracer_like}")   # not an events shape
     """), rules=["telemetry-discipline"])
     assert report.findings == []
 

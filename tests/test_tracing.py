@@ -1,0 +1,402 @@
+"""Cross-process event tracing (ISSUE 10): ring/slab mechanics, the
+clock-offset merge, incarnation-tagged flow ids, torn-slab rejection,
+capture-controller windows, span percentiles, block-lineage wire stamps,
+and the train() acceptance e2e — a /tracez capture of a live
+process-transport + 2-replay-shard run producing a Perfetto-loadable
+Chrome trace with trainer/fleet/shard tracks and a complete
+cut→feedback lineage flow, with pipeline.* histograms in /metrics.
+"""
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.telemetry.registry import MetricsRegistry
+from r2d2_tpu.telemetry.tracing import (
+    EVENT_DTYPE,
+    EventTracer,
+    TraceController,
+    TraceSlab,
+    merge_tracks,
+)
+from r2d2_tpu.utils.trace import Tracer
+
+A = 4
+
+
+def _attach(slab, slot, incarnation, name):
+    w = EventTracer()
+    w.attach(slab.writer_info(slot, incarnation, name))
+    w.poll()
+    return w
+
+
+# ------------------------------------------------------- ring mechanics
+
+def test_disarmed_ring_records_nothing():
+    slab = TraceSlab(1, 128)
+    try:
+        w = _attach(slab, 0, 0, "t")
+        assert not w.armed
+        w.instant("x.y")
+        w.complete("a.b", time.perf_counter(), 0.01)
+        w.flush()
+        tracks, dropped = slab.harvest()
+        assert dropped == 0
+        assert sum(len(t["events"]) for t in tracks) == 0
+        w.detach()
+    finally:
+        slab.close()
+
+
+def test_ring_overflow_keeps_newest_in_order():
+    slab = TraceSlab(1, 64)
+    try:
+        w = _attach(slab, 0, 0, "t")
+        slab.set_armed(True, capture_id=1)
+        w.poll()
+        for i in range(100):
+            w.complete("x.y", float(i), 0.5, arg=i)
+        w.flush()
+        tracks, dropped = slab.harvest()
+        assert dropped == 0 and len(tracks) == 1
+        t = tracks[0]
+        assert t["overflow"] == 100 - 64
+        args = [int(e["arg"]) for e in t["events"]]
+        assert args == list(range(36, 100))       # newest, in order
+        w.detach()
+    finally:
+        slab.close()
+
+
+def test_capture_id_bump_resets_ring():
+    slab = TraceSlab(1, 64)
+    try:
+        w = _attach(slab, 0, 0, "t")
+        slab.set_armed(True, capture_id=1)
+        w.poll()
+        w.instant("old.event")
+        slab.set_armed(True, capture_id=2)
+        w.poll()                       # new capture: ring resets
+        w.instant("new.event")
+        w.flush()
+        tracks, _ = slab.harvest()
+        names = [e["name"].decode() for e in tracks[0]["events"]]
+        assert names == ["new.event"]
+        w.detach()
+    finally:
+        slab.close()
+
+
+# ------------------------------------------- clock model / merge / CRC
+
+def test_merge_is_monotone_per_track_under_clock_offsets():
+    """Two writers with wildly different local clock origins: after the
+    per-writer affine mapping each track's event order (and spacing) is
+    preserved, and the cross-track alignment uses the wall handshake."""
+    slab = TraceSlab(2, 64)
+    try:
+        w0 = _attach(slab, 0, 0, "trainer")
+        w1 = _attach(slab, 1, 0, "fleet0")
+        slab.set_armed(True, capture_id=1)
+        w0.poll(), w1.poll()
+        # fake divergent clock origins via the slab header handshake
+        w0._views["clock"][0] = 0.0       # t0_perf
+        w0._views["clock"][1] = 1000.0    # t0_wall
+        w1._views["clock"][0] = 500.0
+        w1._views["clock"][1] = 1000.0    # same wall origin, offset perf
+        for i in range(5):
+            w0._record(f"a{i}", b"X", 1.0 + i, 0.1, 0, "", 0)
+            w1._record(f"b{i}", b"X", 501.0 + i, 0.1, 0, "", 0)
+        w0.flush(), w1.flush()
+        tracks, dropped = slab.harvest()
+        assert dropped == 0 and len(tracks) == 2
+        doc = merge_tracks(tracks)
+        by_pid = {}
+        for e in doc["traceEvents"]:
+            if e.get("ph") == "X":
+                by_pid.setdefault(e["pid"], []).append(e["ts"])
+        for pid, ts in by_pid.items():
+            assert ts == sorted(ts), f"track {pid} not monotone"
+        # the two writers' events describe the SAME wall instants —
+        # after the offset handshake they land interleaved, not shifted
+        # by the 500 s perf-origin difference
+        a, b = by_pid[0], by_pid[1]
+        assert abs(a[0] - b[0]) < 1.0          # µs-scale, same origin
+        w0.detach(), w1.detach()
+    finally:
+        slab.close()
+
+
+def test_torn_slab_dropped_and_counted():
+    slab = TraceSlab(2, 64)
+    try:
+        w0 = _attach(slab, 0, 0, "good")
+        w1 = _attach(slab, 1, 0, "torn")
+        slab.set_armed(True, capture_id=1)
+        w0.poll(), w1.poll()
+        w0.instant("ok.event")
+        w1.instant("doomed.event")
+        w0.flush(), w1.flush()
+        # garble bytes inside slot 1's event region AFTER its CRC landed
+        buf = np.frombuffer(slab.shm.buf, np.uint8)
+        off = slab.ctrl_nbytes + slab.slot_nbytes \
+            + slab.offsets["events"] + 8
+        buf[off:off + 32] ^= 0xFF
+        del buf           # release the exported pointer before close()
+        tracks, dropped = slab.harvest()
+        assert dropped == 1
+        assert [t["name"] for t in tracks] == ["good"]
+        w0.detach(), w1.detach()
+    finally:
+        slab.close()
+
+
+def test_flow_ids_are_incarnation_tagged_across_respawn():
+    """A respawned fleet re-attaches to the SAME slab slot with a bumped
+    incarnation: its trace ids must never collide with its dead
+    predecessor's (stale ids from the old stream survive in OTHER
+    processes' rings and would otherwise stitch two different blocks
+    into one flow)."""
+    slab = TraceSlab(1, 64)
+    try:
+        w0 = _attach(slab, 0, 0, "fleet0")
+        slab.set_armed(True, capture_id=1)
+        w0.poll()
+        ids0 = {w0.next_trace_id() for _ in range(50)}
+        w0.detach()                      # the SIGKILLed predecessor
+        w1 = _attach(slab, 0, 1, "fleet0")   # watchdog respawn, inc=1
+        w1.poll()
+        ids1 = {w1.next_trace_id() for _ in range(50)}
+        assert not (ids0 & ids1)
+        # the respawned writer's track carries the new incarnation
+        w1.instant("x.y")
+        w1.flush()
+        tracks, _ = slab.harvest()
+        assert tracks[0]["incarnation"] == 1
+        w1.detach()
+    finally:
+        slab.close()
+
+
+# ---------------------------------------------------- capture controller
+
+def test_trace_controller_window_closes_on_step_target(tmp_path):
+    slab = TraceSlab(1, 64)
+    step = dict(n=0)
+    ctl = TraceController(slab, lambda: step["n"], str(tmp_path))
+    ctl.GRACE_SECONDS = 0.0
+    try:
+        w = _attach(slab, 0, 0, "trainer")
+        ctl.tracer = w
+        res = ctl.arm(3)
+        assert res["armed"] and w.armed
+        # a second arm while open is refused
+        assert "error" in ctl.arm(1)
+        assert ctl.poll() is None        # target not reached
+        w.instant("in.window")
+        step["n"] = 3
+        path = ctl.poll()
+        assert path and os.path.exists(path)
+        assert not w.armed
+        doc = json.load(open(path))
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "in.window" in names
+        assert ctl.status()["last"]["events"] == 1
+        # events after the window closed are not recorded
+        w.instant("after.window")
+        assert ctl.last["events"] == 1
+        w.detach()
+    finally:
+        ctl.close()
+
+
+def test_trace_controller_numbers_on_from_existing_dumps(tmp_path):
+    """A resumed run (or a later soak round reusing the ckpt dir) must
+    never overwrite an earlier capture — and a per-round dump check
+    must never false-pass on a stale trace_1.json."""
+    (tmp_path / "trace_3.json").write_text("{}")
+    slab = TraceSlab(1, 64)
+    ctl = TraceController(slab, lambda: 0, str(tmp_path))
+    ctl.GRACE_SECONDS = 0.0
+    try:
+        w = _attach(slab, 0, 0, "trainer")
+        ctl.tracer = w
+        ctl.arm(1)
+        path = ctl.poll(force=True)
+        assert os.path.basename(path) == "trace_4.json"
+        assert (tmp_path / "trace_3.json").read_text() == "{}"
+        w.detach()
+    finally:
+        ctl.close()
+
+
+def test_trace_controller_force_close_dumps_partial(tmp_path):
+    slab = TraceSlab(1, 64)
+    ctl = TraceController(slab, lambda: 0, str(tmp_path))
+    ctl.GRACE_SECONDS = 0.0
+    try:
+        w = _attach(slab, 0, 0, "trainer")
+        ctl.tracer = w
+        ctl.arm(10 ** 9)
+        w.instant("partial.event")
+        assert ctl.poll() is None            # nowhere near the target
+        path = ctl.poll(force=True)          # the shutdown path
+        assert path and os.path.exists(path)
+        w.detach()
+    finally:
+        ctl.close()
+
+
+# ----------------------------------------- span percentiles / registry
+
+def test_tracer_span_percentiles_monotone_and_sane():
+    tr = Tracer(events=EventTracer())     # detached sink: no capture
+    for ms in (1, 1, 1, 1, 1, 1, 1, 1, 1, 100):
+        with tr.span("stage"):
+            pass
+        # inject exact durations instead of sleeping: reach into the
+        # stat (the public span() path is exercised above)
+        tr._spans["stage"].update(ms / 1e3, 0.05)
+    snap = tr.snapshot()
+    p50, p95, p99 = (snap["span.stage.p50_ms"], snap["span.stage.p95_ms"],
+                     snap["span.stage.p99_ms"])
+    assert p50 <= p95 <= p99
+    # ~half the samples are 1 ms, the tail is 100 ms: the quantile
+    # buckets must separate them (log buckets: answers are approximate)
+    assert p50 < 5.0
+    assert p99 > 50.0
+
+
+def test_registry_observe_many_matches_observe_oracle():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    vals = np.abs(np.random.default_rng(0).normal(0.05, 0.2, 500))
+    for v in vals:
+        a.observe("pipeline.block_age_at_train_s", float(v))
+    b.observe_many("pipeline.block_age_at_train_s", vals)
+    ha = a.snapshot()["histograms"]["pipeline.block_age_at_train_s"]
+    hb = b.snapshot()["histograms"]["pipeline.block_age_at_train_s"]
+    assert ha["counts"] == hb["counts"] and ha["count"] == hb["count"]
+    assert ha["sum"] == pytest.approx(hb["sum"])   # summation order
+
+
+# ------------------------------------------------- lineage wire stamps
+
+def test_block_wire_format_carries_lineage_stamps():
+    from r2d2_tpu.replay.block import (
+        block_slot_spec,
+        slot_layout,
+        slot_views,
+        write_block,
+        read_block,
+        slot_crc,
+    )
+    from test_actor_procs import scripted_blocks
+
+    cfg = make_test_config()
+    blocks = scripted_blocks(cfg, 1)
+    block, prios, _ = blocks[0]
+    block.trace_id = 0xDEAD
+    assert block.cut_ts > 0                  # stamped at assembly
+    spec = block_slot_spec(cfg, A)
+    nbytes, offsets = slot_layout(spec)
+    buf = bytearray(nbytes)
+    views = slot_views(memoryview(buf), spec, offsets, nbytes, 0)
+    k, n_obs, n_steps = write_block(views, block, prios)
+    rb, _ = read_block(views, k, n_obs, n_steps)
+    assert rb.trace_id == 0xDEAD
+    assert rb.cut_ts == block.cut_ts
+    # the stamps live OUTSIDE the CRC: garbling them must not cost the
+    # block (telemetry, not experience)
+    views["trace_id"][0] = 1234
+    assert int(views["crc32"][0]) == slot_crc(views, k, n_obs, n_steps)
+
+
+def test_replay_buffer_ages_and_flow_meta():
+    from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+    from test_actor_procs import scripted_blocks
+
+    cfg = make_test_config(learning_starts=8)
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(0))
+    for block, prios, ep in scripted_blocks(cfg, 4, partial_last=False):
+        block.cut_ts = time.time() - 5.0     # a 5 s old block
+        buf.add(block, prios, ep)
+    batch = buf.sample_batch(8)
+    ages = batch["ages"]
+    assert ages.shape == (8, 2)
+    assert (ages[:, 0] >= 4.0).all() and (ages[:, 0] < 60.0).all()
+    assert (ages[:, 1] >= 0.0).all() and (ages[:, 1] < 5.0).all()
+
+
+# ------------------------------------------------------- train() e2e
+
+@pytest.mark.timeout(600)
+def test_train_e2e_tracez_capture_process_transport_sharded(tmp_path):
+    """Acceptance (ISSUE 10): a /tracez capture of a live
+    actor_transport="process" + replay_shards=2 run produces a Chrome
+    trace that parses, carries trainer + fleet + shard process tracks
+    (≥3), contains at least one COMPLETE block-lineage flow (env-step/
+    cut through priority feedback), and /metrics shows
+    pipeline.block_age_at_train_s populated."""
+    from test_actor_procs import make_fake_env
+    from r2d2_tpu.train import train
+
+    cfg = make_test_config(
+        game_name="Fake", training_steps=150, num_actors=2,
+        actor_fleets=1, actor_transport="process", replay_shards=2,
+        buffer_capacity=160, learning_starts=16, log_interval=0.2,
+        telemetry_port=-1, save_interval=10 ** 6)
+    seen = dict(port=0, armed=False, metrics=None)
+
+    def sink(entry):
+        seen["port"] = entry["telemetry_port"]
+        base = f"http://127.0.0.1:{seen['port']}"
+        if not seen["armed"] and entry.get("training_steps", 0) > 0:
+            # arm past the run's end: the shutdown force-close dumps a
+            # window spanning every remaining block lifecycle
+            with urllib.request.urlopen(
+                    base + "/tracez?steps=1000000", timeout=10) as r:
+                assert json.load(r)["armed"]
+            seen["armed"] = True
+        elif seen["armed"] and seen["metrics"] is None:
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                seen["metrics"] = r.read().decode()
+
+    m = train(cfg, env_factory=make_fake_env,
+              checkpoint_dir=str(tmp_path), verbose=False, log_sink=sink,
+              max_wall_seconds=420)
+    assert m["num_updates"] > 0 and not m.get("fabric_failed")
+    assert seen["armed"], "run ended before /tracez could arm"
+
+    # pipeline histograms reached /metrics during the run
+    assert seen["metrics"] is not None
+    count = [ln for ln in seen["metrics"].splitlines()
+             if ln.startswith("r2d2_pipeline_block_age_at_train_s_count")]
+    assert count and float(count[0].split()[-1]) > 0
+    assert "r2d2_pipeline_hop_cut_to_ingest_s_count" in seen["metrics"]
+
+    dumps = [f for f in os.listdir(tmp_path / "telemetry")
+             if f.startswith("trace_") and f.endswith(".json")]
+    assert dumps, "force-closed capture left no dump"
+    doc = json.load(open(tmp_path / "telemetry" / dumps[0]))
+    evs = doc["traceEvents"]
+    tracks = sorted(e["args"]["name"] for e in evs
+                    if e.get("ph") == "M" and e["name"] == "process_name")
+    assert "trainer" in tracks and "fleet0" in tracks
+    assert {"shard0", "shard1"} <= set(tracks)
+    assert len(tracks) >= 3
+    flows = {}
+    for e in evs:
+        if e.get("ph") in ("s", "t", "f"):
+            flows.setdefault(e["id"], set()).add(e["ph"])
+    complete = [i for i, phs in flows.items() if {"s", "f"} <= phs]
+    assert complete, "no complete cut→feedback lineage flow in the dump"
+    names = {e["name"] for e in evs}
+    assert {"block.env_steps+cut", "ingest.block", "replay.route",
+            "replay.sample", "replay.priority_feedback"} <= names

@@ -11,7 +11,8 @@ replay mass/size match the snapshot meta, the learner state restores,
 and training keeps advancing.  Exit code 1 on any violated invariant.
 
 Run:  python tools/chaos_soak.py [minutes] [--process] [--serve]
-                                 [--anakin] [--shards] [--out OUT.json]
+                                 [--anakin] [--shards] [--trace]
+                                 [--out OUT.json]
 
 ``--process`` soaks the subprocess actor plane (enables the kill_fleet /
 garble_block sites); ``--serve`` additionally routes acting through the
@@ -31,8 +32,14 @@ armed: every round must finish with zero learner stalls, all shards
 alive (the watchdog respawned every kill), every garbled response
 caught-and-retried, and conserved priority accounting (the plane's
 training-step count equals the learner's updates — no feedback silently
-lost outside the counted cross-respawn drops).  Default soaks the
-thread transport (freeze + truncate sites only).
+lost outside the counted cross-respawn drops).  ``--trace`` (implies
+--process) adds a tracing round: once the first round has seen a
+kill_fleet fire, a cross-process capture window is armed mid-soak over
+/tracez, and the round fails unless the dump parses as Chrome trace
+JSON and carries events from the respawned fleet's NEW incarnation
+(the slab slot re-attached with a bumped incarnation tag —
+telemetry/tracing.py).  Default soaks the thread transport (freeze +
+truncate sites only).
 """
 import json
 import os
@@ -46,7 +53,8 @@ _argv = sys.argv[1:]
 SERVE = "--serve" in _argv
 ANAKIN = "--anakin" in _argv
 SHARDS = "--shards" in _argv
-PROCESS = "--process" in _argv or SERVE
+TRACE = "--trace" in _argv
+PROCESS = "--process" in _argv or SERVE or TRACE
 OUT = None
 if "--out" in _argv:
     i = _argv.index("--out")
@@ -75,6 +83,48 @@ A = 4
 def env_factory(cfg, seed):
     return FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=seed,
                         episode_len=32)
+
+
+def _trace_dumps(ck_dir: str):
+    """Existing capture dumps, numerically sorted (numbers continue
+    across rounds/resumes; a lexical sort would rank trace_2.json above
+    trace_10.json)."""
+    tel = os.path.join(ck_dir, "telemetry")
+    try:
+        names = os.listdir(tel)
+    except FileNotFoundError:
+        return []
+    return sorted((f for f in names if f.startswith("trace_")
+                   and f.endswith(".json")),
+                  key=lambda f: int(f[len("trace_"):-5]))
+
+
+def _check_trace_dump(ck_dir: str, pre_existing):
+    """--trace round verdict: THIS round's capture dump (not a stale one
+    from an earlier round) must parse as Chrome trace JSON and carry
+    events recorded by a respawned fleet's NEW incarnation (tid = the
+    slab slot's incarnation tag — a kill fired before arming, so the
+    live writer is a respawn).  Returns an error string, or None when
+    the invariant holds."""
+    tel = os.path.join(ck_dir, "telemetry")
+    dumps = [f for f in _trace_dumps(ck_dir) if f not in pre_existing]
+    if not dumps:
+        return "trace armed but no NEW dump was written this round"
+    try:
+        with open(os.path.join(tel, dumps[-1])) as f:
+            evs = json.load(f)["traceEvents"]
+    except (ValueError, KeyError) as e:
+        return f"trace dump does not parse: {e}"
+    fleet_pids = {e["pid"] for e in evs
+                  if e.get("ph") == "M" and e.get("name") == "process_name"
+                  and e["args"]["name"].startswith("fleet")}
+    if not fleet_pids:
+        return "trace dump has no fleet track"
+    if not any(e.get("ph") == "X" and e["pid"] in fleet_pids
+               and e.get("tid", 0) >= 1 for e in evs):
+        return ("trace dump has no events from a respawned fleet "
+                "incarnation (tid >= 1)")
+    return None
 
 
 def main() -> int:
@@ -123,6 +173,11 @@ def main() -> int:
                       ";drop_act_response:p=0.002"
                       ";garble_act_response:p=0.002")
             extra = dict(act_response_timeout=0.5)
+    if TRACE:
+        # the /tracez arming below needs the exporter; kill_fleet rides
+        # along from the --process spec so a respawned incarnation
+        # exists to capture
+        extra = dict(extra, telemetry_port=-1)
     cfg = test_config(
         game_name="Fake", training_steps=10 ** 9, log_interval=1.0,
         save_interval=200, keep_checkpoints=3, chaos_spec=chaos,
@@ -154,13 +209,40 @@ def main() -> int:
                     rcfg = cfg.replace(
                         chaos_spec="wedge_dispatch:every=60,"
                                    f"dur={dur},n=1000000")
+                trace_state = dict(armed=False)
+                pre_dumps = set(_trace_dumps(ck_dir)) if TRACE else set()
+
+                def log_sink(e, r=rnd, ts=trace_state):
+                    runlog.append(dict(e, round=r))
+                    # --trace round: once a fleet kill fired, arm a
+                    # capture spanning the rest of the round (the
+                    # shutdown force-close dumps it) — the respawned
+                    # fleet's NEW incarnation is then the live writer
+                    if (TRACE and not ts["armed"]
+                            and (e.get("chaos") or {}).get("kill_fleet")
+                            and e.get("telemetry_port")):
+                        import urllib.request
+
+                        try:
+                            urllib.request.urlopen(
+                                "http://127.0.0.1:%d/tracez?steps=%d"
+                                % (e["telemetry_port"], 10 ** 9),
+                                timeout=5).read()
+                            ts["armed"] = True
+                        except Exception as exc:
+                            print(f"trace arm failed: {exc}",
+                                  file=sys.stderr)
+
                 m = train(rcfg, checkpoint_dir=ck_dir, resume=rnd > 1,
                           verbose=False,
-                          log_sink=lambda e, r=rnd: runlog.append(
-                              dict(e, round=r)),
+                          log_sink=log_sink,
                           max_wall_seconds=min(45.0,
                                                deadline - time.time()),
                           **kwargs)
+                if TRACE and trace_state["armed"]:
+                    err = _check_trace_dump(ck_dir, pre_dumps)
+                    if err:
+                        failures.append(f"round {rnd}: {err}")
                 ck = Checkpointer(ck_dir)
                 fleet = m.get("fleet_health") or {}
                 rec = dict(round=rnd, updates=m["num_updates"],

@@ -1,0 +1,116 @@
+"""Terminal summary of a dumped cross-process trace.
+
+``/tracez`` (telemetry/tracing.py) dumps Chrome-trace-event JSON meant
+for Perfetto; this is the no-browser view over the same file: per-track
+utilization, the heaviest spans, stall attribution for the threads that
+matter (what was the learner actually waiting on?), and the
+block-lineage flow decomposition (per-hop latency from env-step/cut to
+priority feedback).
+
+Run:  python tools/trace_view.py <ckpt_dir>/telemetry/trace_1.json
+"""
+import json
+import sys
+from collections import defaultdict
+
+# spans that are WAITING (the thread is parked, not working) — the
+# stall-attribution split.  Everything else on a track counts as busy.
+WAIT_SPANS = ("learner.batch_wait", "buffer.sample_batch",
+              "learner.result_sync", "fleet.block_send")
+
+# lineage hop order (docs/OBSERVABILITY.md §Tracing)
+HOP_ORDER = ("block.env_steps+cut", "fleet.block_send", "ingest.block",
+             "replay.route", "replay.add_block", "replay.sample",
+             "replay.priority_feedback")
+
+
+def load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def summarize(events):
+    track_names = {}
+    slices = defaultdict(list)          # (pid, tid) -> [(name, ts, dur)]
+    flows = defaultdict(list)           # flow id -> [(name, ts, ph)]
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            track_names[e["pid"]] = e["args"]["name"]
+        elif e.get("ph") == "X":
+            slices[(e["pid"], e.get("tid", 0))].append(
+                (e["name"], e["ts"], e.get("dur", 0.0)))
+        elif e.get("ph") in ("s", "t", "f"):
+            flows[e["id"]].append((e.get("name", ""), e["ts"], e["ph"]))
+
+    out = []
+    out.append(f"{len(events)} events, {len(track_names)} process tracks "
+               f"({len(slices)} with slices), {len(flows)} lineage flows")
+    out.append("")
+    out.append("-- per-track utilization (busy = slice time / track "
+               "span; wait = parked spans; a process track sums its "
+               "threads, so >100% means real concurrency) --")
+    span_totals = defaultdict(lambda: [0.0, 0])   # name -> [total_us, n]
+    for (pid, tid), rows in sorted(slices.items()):
+        t0 = min(ts for _, ts, _ in rows)
+        t1 = max(ts + d for _, ts, d in rows)
+        span = max(1.0, t1 - t0)
+        busy = sum(d for n, _, d in rows if n not in WAIT_SPANS)
+        wait = sum(d for n, _, d in rows if n in WAIT_SPANS)
+        name = track_names.get(pid, f"pid{pid}")
+        out.append(f"  {name + (f'/inc{tid}' if tid else ''):<16} "
+                   f"{len(rows):>6} slices  span {span / 1e6:7.2f}s  "
+                   f"busy {100 * busy / span:5.1f}%  "
+                   f"waiting {100 * wait / span:5.1f}%")
+        for n, _, d in rows:
+            span_totals[n][0] += d
+            span_totals[n][1] += 1
+    out.append("")
+    out.append("-- heaviest spans (total time; * = a wait, i.e. the "
+               "thread was stalled on the stage upstream) --")
+    for n, (tot, cnt) in sorted(span_totals.items(),
+                                key=lambda kv: -kv[1][0])[:12]:
+        mark = "*" if n in WAIT_SPANS else " "
+        out.append(f" {mark}{n:<34} {tot / 1e6:8.3f}s  x{cnt:<6} "
+                   f"avg {tot / cnt / 1e3:7.2f}ms")
+
+    # lineage: per-hop deltas over complete (s ... f) chains
+    hop_lat = defaultdict(list)
+    complete = 0
+    for rows in flows.values():
+        rows.sort(key=lambda r: r[1])
+        phases = {ph for _, _, ph in rows}
+        if not ({"s", "f"} <= phases):
+            continue
+        complete += 1
+        # flow points carry the generic name "block"; pair them with the
+        # enclosing hop via order — deltas between consecutive points
+        for (n0, ts0, _), (n1, ts1, _) in zip(rows, rows[1:]):
+            hop_lat["hop"].append(ts1 - ts0)
+        hop_lat["end_to_end"].append(rows[-1][1] - rows[0][1])
+    out.append("")
+    out.append(f"-- block lineage ({complete} complete cut→feedback "
+               "flows) --")
+    for key in ("end_to_end", "hop"):
+        vals = sorted(hop_lat.get(key, []))
+        if not vals:
+            continue
+        p = lambda q: vals[min(len(vals) - 1, int(q * len(vals)))] / 1e3
+        out.append(f"  {key:<12} p50 {p(0.5):9.2f}ms   "
+                   f"p95 {p(0.95):9.2f}ms   max {vals[-1] / 1e3:9.2f}ms")
+    if complete == 0:
+        out.append("  (no complete flows — was the capture window long "
+                   "enough to span a block's cut→train→feedback life?)")
+    return "\n".join(out)
+
+
+def main(argv):
+    if len(argv) != 1:
+        print(__doc__)
+        return 2
+    print(summarize(load(argv[0])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
