@@ -115,10 +115,18 @@ def _resolve_act_device(spec: str):
     return None
 
 
-def make_act_fn(cfg: Config, net: R2D2Network):
+def make_act_fn(cfg: Config, net: R2D2Network, *,
+                retrace_name: str = "actor.act",
+                retrace_budget: Optional[int] = None):
     """Jitted batched single-step inference:
     (params, obs (B,*obs) u8, last_action (B,A) f32, last_reward (B,) f32,
     hidden (B,2,layers,H)) → (q (B,A) f32, new hidden).
+
+    ``retrace_name``/``retrace_budget`` override the RETRACES guard entry
+    (default: one fixed lane batch, budget 2) — the session tier's
+    continuous batcher (serving/batcher.py) reuses this same twin
+    resolution but legitimately traces once per bucket shape, so it
+    registers under its own name with a bucket-count budget.
 
     When actor inference runs on the host CPU backend (``cfg.act_device``
     "auto"/"cpu" with an accelerator default backend — see
@@ -159,7 +167,8 @@ def make_act_fn(cfg: Config, net: R2D2Network):
     # hot loop — the e2e tests assert the budget holds
     from r2d2_tpu.utils.trace import RETRACES
 
-    return jax.jit(RETRACES.wrap("actor.act", act))
+    return jax.jit(RETRACES.wrap(retrace_name, act,
+                                 budget=retrace_budget))
 
 
 class VectorActor:

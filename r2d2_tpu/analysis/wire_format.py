@@ -1,19 +1,20 @@
-"""wire-format: the shm slot layout and CRC live in ONE module.
+"""wire-format: the slot layout and CRC conventions live in ONE module.
 
-Four modules speak the shared-memory wire format (``replay/block.py``
+Five modules speak the shared-memory wire format (``replay/block.py``
 defines it; ``parallel/actor_procs.py``,
 ``parallel/inference_service.py`` and ``parallel/replay_shards.py`` —
 the sharded replay plane's block-routing and sample-RPC slabs —
-transport over it).  The CRC32 convention — int64 header words, payload
-arrays in declared order, the 32-bit mask, written LAST — is a
-torn-write detector only as long as the producer and verifier agree
-bit-for-bit; a restated literal in one of the transport modules is
-exactly the kind of drift that ships silently and corrupts recovery
-later.
+transport over it), and the session tier (``serving/wire.py``) carries
+the same conventions onto a local-socket transport for external
+clients.  The CRC32 convention — int64 header words, payload arrays in
+declared order, the 32-bit mask, written LAST — is a torn-write
+detector only as long as the producer and verifier agree bit-for-bit;
+a restated literal in one of the transport modules is exactly the kind
+of drift that ships silently and corrupts recovery later.
 
 The rule fires in any module that imports ``multiprocessing
-.shared_memory`` (the shm-transport signature) **other than the wire
--format module itself** when it:
+.shared_memory`` or ``socket`` (the transport signatures) **other than
+the wire-format modules themselves** when it:
 
 - calls ``zlib.crc32`` directly (use ``replay.block.payload_crc32``),
 - restates the 32-bit CRC mask literal ``0xFFFFFFFF``,
@@ -22,7 +23,13 @@ The rule fires in any module that imports ``multiprocessing
   ``write_block`` / ``read_block`` / ``payload_crc32``) instead of
   importing it,
 - uses a wire-format name without importing it from
-  ``r2d2_tpu.replay.block``.
+  ``r2d2_tpu.replay.block``,
+- and likewise for the session request/response vocabulary
+  (``session_request_spec`` / ``session_response_spec`` /
+  ``encode_frame`` / ``decode_frame`` / ``peek_kind`` /
+  ``FrameReader``), whose canonical home is
+  ``r2d2_tpu.serving.wire`` (itself built ON the replay/block.py
+  helpers — one CRC definition all the way down).
 """
 from __future__ import annotations
 
@@ -38,6 +45,14 @@ WIRE_MODULE_SUFFIX = "replay/block.py"
 WIRE_NAMES = {"slot_layout", "slot_views", "slot_crc", "block_slot_spec",
               "batch_slot_spec", "write_block", "read_block",
               "payload_crc32", "CRC_MASK", "BATCH_ROW_FIELDS"}
+# the session tier's request/response vocabulary: defined once in
+# serving/wire.py (on top of the replay/block.py CRC helpers), imported
+# by every module that speaks the session protocol
+SESSION_WIRE_MODULE = "r2d2_tpu.serving.wire"
+SESSION_WIRE_MODULE_SUFFIX = "serving/wire.py"
+SESSION_WIRE_NAMES = {"session_request_spec", "session_response_spec",
+                      "encode_frame", "decode_frame", "peek_kind",
+                      "FrameReader"}
 CRC_MASK_VALUE = 0xFFFFFFFF
 
 
@@ -59,55 +74,81 @@ def _uses_shared_memory(tree: ast.AST) -> bool:
     return False
 
 
-def _block_imports(tree: ast.AST) -> Set[str]:
-    """Wire-format names imported from the canonical module."""
+def _uses_socket(tree: ast.AST) -> bool:
+    """The session tier's transport signature (serving/wire.py framing
+    runs over plain ``socket``)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "socket" or a.name.startswith("socket.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "socket":
+                return True
+    return False
+
+
+def _imports_from(tree: ast.AST, module: str) -> Set[str]:
+    """Names imported from one canonical wire module."""
     out: Set[str] = set()
     for node in ast.walk(tree):
-        if (isinstance(node, ast.ImportFrom)
-                and node.module == WIRE_MODULE):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
             out.update(a.asname or a.name for a in node.names)
     return out
 
 
-@rule(RULE, "shm transport modules import the slot layout / CRC from "
-            "replay/block.py instead of restating literals")
+# (canonical module, its path suffix, its vocabulary) — the replay slab
+# conventions and the session socket conventions, checked identically
+_VOCABULARIES = (
+    (WIRE_MODULE, WIRE_MODULE_SUFFIX, WIRE_NAMES),
+    (SESSION_WIRE_MODULE, SESSION_WIRE_MODULE_SUFFIX, SESSION_WIRE_NAMES),
+)
+
+
+@rule(RULE, "transport modules (shm or socket) import the slot layout / "
+            "CRC / frame vocabulary from its canonical module instead of "
+            "restating literals")
 def check_wire_format(ctx: Context) -> List[Finding]:
     findings: List[Finding] = []
     for mod in ctx.modules:
-        if mod.rel.endswith(WIRE_MODULE_SUFFIX):
+        if not (_uses_shared_memory(mod.tree) or _uses_socket(mod.tree)):
             continue
-        if not _uses_shared_memory(mod.tree):
-            continue
-        imported = _block_imports(mod.tree)
+        vocabularies = [
+            (module, names, _imports_from(mod.tree, module))
+            for module, suffix, names in _VOCABULARIES
+            if not mod.rel.endswith(suffix)]
+        is_wire_module = len(vocabularies) < len(_VOCABULARIES)
         for node in ast.walk(mod.tree):
-            if isinstance(node, ast.Call):
+            if isinstance(node, ast.Call) and not is_wire_module:
                 d = dotted_name(node.func)
                 if d in ("zlib.crc32", "crc32"):
                     findings.append(Finding(
                         RULE, mod.rel, node.lineno,
-                        "direct zlib.crc32 in an shm transport module — "
+                        "direct zlib.crc32 in a transport module — "
                         "compute integrity words via "
                         "replay.block.payload_crc32 so producer and "
                         "verifier can never drift"))
             elif (isinstance(node, ast.Constant)
                   and type(node.value) is int
-                  and node.value == CRC_MASK_VALUE):
+                  and node.value == CRC_MASK_VALUE
+                  and not is_wire_module):
                 findings.append(Finding(
                     RULE, mod.rel, node.lineno,
                     "restated CRC mask literal 0xFFFFFFFF — import the "
                     "convention from replay.block (payload_crc32/CRC_MASK)"))
-            elif (isinstance(node, ast.FunctionDef)
-                  and node.name in WIRE_NAMES):
-                findings.append(Finding(
-                    RULE, mod.rel, node.lineno,
-                    f"wire-format function {node.name!r} re-defined here — "
-                    f"import it from {WIRE_MODULE}"))
-            elif (isinstance(node, ast.Name)
-                  and isinstance(node.ctx, ast.Load)
-                  and node.id in WIRE_NAMES
-                  and node.id not in imported):
-                findings.append(Finding(
-                    RULE, mod.rel, node.lineno,
-                    f"wire-format name {node.id!r} used without importing "
-                    f"it from {WIRE_MODULE}"))
+            for module, names, imported in vocabularies:
+                if (isinstance(node, (ast.FunctionDef, ast.ClassDef))
+                        and node.name in names):
+                    findings.append(Finding(
+                        RULE, mod.rel, node.lineno,
+                        f"wire-format {node.name!r} re-defined here — "
+                        f"import it from {module}"))
+                elif (isinstance(node, ast.Name)
+                      and isinstance(node.ctx, ast.Load)
+                      and node.id in names
+                      and node.id not in imported):
+                    findings.append(Finding(
+                        RULE, mod.rel, node.lineno,
+                        f"wire-format name {node.id!r} used without "
+                        f"importing it from {module}"))
     return findings

@@ -256,6 +256,62 @@ class Checkpointer:
         return meta, os.path.join(path, "ring.bin"), actors
 
 
+    # ---------------------------------------------------- session snapshot
+    def _sessions_path(self) -> str:
+        return os.path.join(self.directory, "sessions.snap")
+
+    def save_sessions(self, writer: Callable[[str], Dict[str, Any]]
+                      ) -> Optional[Dict[str, Any]]:
+        """Persist the session tier's live-episode store (serving/
+        store.py) atomically — the replay-snapshot discipline at session
+        scale: ``writer(payload_path)`` serialises the hidden pool +
+        per-session meta and returns its JSON-able meta; everything
+        lands in a tmp dir with ``meta.json`` committed last, then one
+        rename publishes it.  One snapshot, latest-wins (a server
+        restart only ever resumes the newest state; the chaos truncate
+        drill rides the same hook as the replay snapshot)."""
+        final = self._sessions_path()
+        tmp = f"{final}.tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        try:
+            meta = dict(writer(os.path.join(tmp, "sessions.bin")))
+            if self.chaos is not None and self.chaos.fire("truncate_ckpt"):
+                return  # injected crash: the partial tmp dir IS the drill
+            mtmp = os.path.join(tmp, "meta.json.tmp")
+            with open(mtmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(mtmp, os.path.join(tmp, "meta.json"))
+            # two renames, never a window with NO committed snapshot:
+            # the predecessor steps aside to ``.old`` (restore's
+            # fallback), the new one lands, the fallback is collected.
+            # A crash between the renames still restores the old state
+            old = f"{final}.old"
+            shutil.rmtree(old, ignore_errors=True)
+            if os.path.isdir(final):
+                os.replace(final, old)
+            os.replace(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+            return meta
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    def restore_sessions(self) -> Optional[Tuple[Dict[str, Any], str]]:
+        """``(meta, payload_path)`` of the committed session snapshot,
+        or None (no snapshot, or a torn one whose meta.json never
+        landed — never selected).  Falls back to the ``.old`` snapshot a
+        crash mid-publish may have left as the only committed state."""
+        for path in (self._sessions_path(), f"{self._sessions_path()}.old"):
+            meta_path = os.path.join(path, "meta.json")
+            if not os.path.exists(meta_path):
+                continue
+            with open(meta_path) as f:
+                meta = json.load(f)
+            return meta, os.path.join(path, "sessions.bin")
+        return None
+
+
 def truncate_checkpoint_dir(path: str) -> None:
     """Simulate a crash mid-save: truncate the largest file under ``path``
     to half its size (the torn-payload shape a real preemption leaves).

@@ -200,6 +200,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     pt.add_argument("--max-wall-seconds", type=float, default=None)
     pt.add_argument("--quiet", action="store_true")
 
+    pv = sub.add_parser(
+        "serve", help="session-serving tier over a trained checkpoint")
+    _add_common(pv)
+    pv.add_argument("--port", type=int, default=None, metavar="PORT",
+                    help="listen port for session traffic on 127.0.0.1 "
+                         "(overrides cfg.serve_port; -1 = ephemeral, "
+                         "printed at start).  Clients speak the "
+                         "serving/wire.py framed protocol "
+                         "(docs/SERVING.md)")
+    pv.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve /metrics (serving.* histograms incl. act "
+                         "latency), three-state /healthz and /statusz on "
+                         "127.0.0.1:PORT (overrides cfg.telemetry_port; "
+                         "-1 = ephemeral, default off)")
+    pv.add_argument("--action-dim", type=int, default=None, metavar="A",
+                    help="the policy's action count; default creates the "
+                         "configured env once to read it")
+    pv.add_argument("--resume-sessions", action="store_true",
+                    help="restore the live-session snapshot a previous "
+                         "server wrote at shutdown, resuming mid-episode "
+                         "sessions bit-exact (clients reconnect and "
+                         "continue by session id)")
+    pv.add_argument("--max-wall-seconds", type=float, default=None)
+    pv.add_argument("--quiet", action="store_true")
+
     pe = sub.add_parser("eval", help="checkpoint sweep -> learning curve")
     _add_common(pe)
     pe.add_argument("--episodes", type=int, default=None)
@@ -292,6 +318,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "worth profiling)")
         metrics = fn(cfg, **kwargs)
         print(json.dumps({k: v for k, v in metrics.items()
+                          if isinstance(v, (int, float, str))}))
+        return 0
+
+    if args.cmd == "serve":
+        if not args.ckpt_dir:
+            parser.error("serve requires --ckpt-dir (the checkpoints to "
+                         "serve)")
+        try:
+            if args.port is not None:
+                cfg = cfg.replace(serve_port=args.port)
+            if args.metrics_port is not None:
+                cfg = cfg.replace(telemetry_port=args.metrics_port)
+        except ValueError as e:
+            parser.error(str(e))
+        from r2d2_tpu.serving import run_server
+
+        summary = run_server(
+            cfg, args.ckpt_dir, action_dim=args.action_dim,
+            resume_sessions=args.resume_sessions,
+            max_wall_seconds=args.max_wall_seconds,
+            verbose=not args.quiet)
+        print(json.dumps({k: v for k, v in summary.items()
                           if isinstance(v, (int, float, str))}))
         return 0
 

@@ -284,6 +284,50 @@ class Config:
                                       # compile; the old behavior was a
                                       # hardcoded 600 s then a fleet-
                                       # killing RuntimeError)
+    # --- session-serving tier (r2d2_tpu/serving, docs/SERVING.md) --------
+    serve_port: int = -1              # session tier listen port
+                                      # (127.0.0.1): > 0 binds that port,
+                                      # -1 (default) binds an ephemeral
+                                      # OS-assigned one (the bound port
+                                      # is printed / on SessionServer
+                                      # .port).  Used by `r2d2_tpu serve`
+    serve_max_sessions: int = 1024    # server-resident recurrent-state
+                                      # budget: concurrent sessions whose
+                                      # (2, layers, H) hidden lives in
+                                      # the SessionStore pool; admitting
+                                      # past it LRU-evicts the least-
+                                      # recently-used idle session (an
+                                      # in-flight session is never
+                                      # evicted — the admit sheds
+                                      # instead)
+    serve_max_batch: int = 256        # continuous-batching cap: the
+                                      # batch loop drains up to this many
+                                      # pending act requests per turn and
+                                      # bucket-pads them into one of
+                                      # log2(serve_max_batch)+1 pre-
+                                      # compiled act entry points
+                                      # (serving/batcher.py)
+    serve_dtype: str = "float32"      # quantized act path: "bfloat16"
+                                      # rounds every f32 param leaf
+                                      # through bf16 at publish (QuaRL
+                                      # weights-only quantization, the
+                                      # param_pump_dtype pattern on the
+                                      # serving tier), gated by the
+                                      # greedy-action-parity test
+    serve_session_idle_s: float = 60.0  # idle-reap timeout: a session
+                                      # untouched this long (and not in
+                                      # flight) is reaped — abandoned
+                                      # clients must never pin hidden-
+                                      # state slots
+    serve_pending_max: int = 4096     # bound on the admission queue:
+                                      # past it act requests are shed
+                                      # with a 429-style reply (counted
+                                      # in serving.rejected) — never an
+                                      # unbounded wait
+    serve_request_deadline: float = 5.0  # per-request deadline: a
+                                      # request still queued past this
+                                      # answers 408 instead of being
+                                      # served stale (the client gave up)
     replay_shards: int = 1            # host replay owner processes
                                       # (parallel/replay_shards.py): 1 =
                                       # the in-process ring+sum-tree (the
@@ -545,6 +589,30 @@ class Config:
                 "forever — there is no unbounded mode)")
         if self.dispatch_deadline < 0:
             raise ValueError("dispatch_deadline must be >= 0 (0 disables)")
+        if not (-1 <= self.serve_port <= 65535):
+            raise ValueError(
+                f"serve_port must be in [-1, 65535] (-1 = ephemeral), "
+                f"got {self.serve_port}")
+        if self.serve_max_sessions < 1:
+            raise ValueError("serve_max_sessions must be >= 1")
+        if self.serve_max_batch < 1:
+            raise ValueError("serve_max_batch must be >= 1")
+        if self.serve_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown serve_dtype {self.serve_dtype!r} "
+                "(expected 'float32' or 'bfloat16')")
+        if self.serve_session_idle_s <= 0:
+            raise ValueError(
+                "serve_session_idle_s must be > 0 (the idle reaper is "
+                "what keeps abandoned sessions from pinning hidden-state "
+                "slots — there is no unbounded mode)")
+        if self.serve_pending_max < 1:
+            raise ValueError("serve_pending_max must be >= 1")
+        if self.serve_request_deadline <= 0:
+            raise ValueError(
+                "serve_request_deadline must be > 0 (the per-request "
+                "deadline is what keeps a backlogged tier from serving "
+                "replies nobody awaits — there is no unbounded mode)")
         if self.superstep_k < 1:
             raise ValueError("superstep_k must be >= 1")
         if self.superstep_pipeline < 0:

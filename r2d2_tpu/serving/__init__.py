@@ -1,0 +1,26 @@
+"""Session-serving tier: the trained Q-network as a product.
+
+``r2d2_tpu serve --ckpt-dir ...`` runs a :class:`SessionServer` —
+thousands of concurrent episodic sessions with session-resident
+recurrent state, continuous batching, admission control and a
+``serving.*`` telemetry namespace — over a training run's checkpoints.
+See docs/SERVING.md for the architecture and ``serving/server.py`` for
+the composition.
+"""
+from r2d2_tpu.serving.admission import AdmissionController, Request
+from r2d2_tpu.serving.batcher import ContinuousBatcher, bucket_sizes
+from r2d2_tpu.serving.client import SessionClient, SessionClientError
+from r2d2_tpu.serving.server import SessionServer, run_server
+from r2d2_tpu.serving.store import SessionStore
+
+__all__ = [
+    "AdmissionController",
+    "ContinuousBatcher",
+    "Request",
+    "SessionClient",
+    "SessionClientError",
+    "SessionServer",
+    "SessionStore",
+    "bucket_sizes",
+    "run_server",
+]

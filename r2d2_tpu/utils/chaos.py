@@ -58,6 +58,20 @@ recovery path the fabric claims to have can be exercised under load:
                       (``cfg.replay_sample_timeout``) must fire and the
                       stalled shard's rows redistribute over the healthy
                       shards' mass — zero learner stalls.
+- ``kill_session_client`` — (session tier, tools/session_load_gen.py)
+                      a load-gen worker drops its connection abruptly,
+                      abandoning every session it owned mid-episode;
+                      the SessionServer must reap them on the
+                      disconnect (``serving.reaped``) — hidden-state
+                      slots never leak, and the tier's health stays
+                      ``ok``/``degraded``.
+- ``slow_session_client`` — (session tier) one load-gen session
+                      freezes for ``dur`` seconds mid-episode — a
+                      straggler.  Continuous batching must keep serving
+                      everyone else (the batch is whatever is pending,
+                      never a lockstep window a straggler can hold
+                      hostage); the session either resumes or idle-
+                      reaps.
 
 Spec grammar — semicolon-separated ``kind[:key=val[,key=val...]]``::
 
@@ -93,7 +107,8 @@ log = logging.getLogger(__name__)
 _KINDS = ("kill_fleet", "garble_block", "truncate_ckpt", "freeze_learner",
           "freeze_service", "drop_act_response", "garble_act_response",
           "stall_pump", "wedge_dispatch", "kill_replay_shard",
-          "garble_sample_response", "stall_shard")
+          "garble_sample_response", "stall_shard", "kill_session_client",
+          "slow_session_client")
 
 
 def parse_spec(spec: str) -> Dict[str, Dict[str, float]]:
@@ -290,6 +305,20 @@ class ChaosInjector:
             except (ProcessLookupError, OSError):
                 pass   # died while stopped: the watchdog takes over
         return s
+
+    def session_client_kill(self) -> bool:
+        """One opportunity per load-gen client step burst: True = the
+        worker must DROP its connection without closing its sessions
+        (mid-episode abandon) — the SessionServer's disconnect reap must
+        free every owned hidden slot (tools/session_load_gen.py)."""
+        return self.fire("kill_session_client") is not None
+
+    def session_client_slow_seconds(self) -> float:
+        """Seconds one load-gen session should freeze mid-episode (0.0 =
+        no straggler injected) — the continuous batch must keep serving
+        the other sessions at full rate meanwhile."""
+        prm = self.fire("slow_session_client")
+        return float(prm.get("dur", 2.0)) if prm else 0.0
 
     def drop_response(self) -> bool:
         """One opportunity per served response token: True = the service

@@ -67,6 +67,9 @@ def test_repo_tree_is_clean():
         # bounded measured bench producer thread (stop-event + joined),
         # same justification as bench.py's measured threads
         ("tools/replay_bench.py", "thread-discipline"),
+        # fixed 3-entry literal-name table publishing client-side latency
+        # percentiles into the shared registry (not a hot-loop key)
+        ("tools/session_load_gen.py", "telemetry-discipline"),
     }, suppressed_at
 
 
@@ -473,6 +476,56 @@ def test_wire_format_covers_shard_rpc_shapes():
         def crc(views, seq, n):
             return payload_crc32((seq, n),
                                  [views[f][:n] for f in BATCH_ROW_FIELDS])
+    """), rules=["wire-format"])
+    assert report.findings == []
+
+
+def test_wire_format_covers_session_socket_vocabulary():
+    """The session tier's request/response vocabulary (ISSUE 11) is
+    wire-format-guarded on the SOCKET transport signature: a module
+    importing ``socket`` that redefines ``session_request_spec`` /
+    ``encode_frame`` (or uses ``decode_frame``/``FrameReader`` without
+    importing them from serving/wire.py), or restates the CRC literal,
+    is a finding — external clients and the server must frame
+    bit-identically or torn traffic ships silently."""
+    report = analyze_source(_src("""
+        import socket
+        import zlib
+
+        def session_request_spec(cfg, action_dim):
+            return ()
+
+        class FrameReader:
+            pass
+
+        def handle(body):
+            h, v = decode_frame((), body)
+            return zlib.crc32(body) & 0xFFFFFFFF
+    """), rules=["wire-format"])
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "'session_request_spec' re-defined" in msgs
+    assert "'FrameReader' re-defined" in msgs
+    assert "'decode_frame' used without importing" in msgs
+    assert "r2d2_tpu.serving.wire" in msgs
+    assert "zlib.crc32" in msgs and "0xFFFFFFFF" in msgs
+    # the sanctioned shape — the server/client modules' own — is clean
+    report = analyze_source(_src("""
+        import socket
+        from r2d2_tpu.serving.wire import (
+            FrameReader, decode_frame, encode_frame, peek_kind,
+            session_request_spec)
+
+        def handle(sock, body):
+            kind = peek_kind(body)
+            return decode_frame(session_request_spec(None, 4), body)
+    """), rules=["wire-format"])
+    assert report.findings == []
+    # socket alone (no wire names, no CRC math) is out of scope
+    report = analyze_source(_src("""
+        import socket
+
+        def dial(host, port):
+            return socket.create_connection((host, port))
     """), rules=["wire-format"])
     assert report.findings == []
 

@@ -12,7 +12,7 @@ and training keeps advancing.  Exit code 1 on any violated invariant.
 
 Run:  python tools/chaos_soak.py [minutes] [--process] [--serve]
                                  [--anakin] [--shards] [--trace]
-                                 [--out OUT.json]
+                                 [--sessions] [--out OUT.json]
 
 ``--process`` soaks the subprocess actor plane (enables the kill_fleet /
 garble_block sites); ``--serve`` additionally routes acting through the
@@ -32,7 +32,18 @@ armed: every round must finish with zero learner stalls, all shards
 alive (the watchdog respawned every kill), every garbled response
 caught-and-retried, and conserved priority accounting (the plane's
 training-step count equals the learner's updates — no feedback silently
-lost outside the counted cross-respawn drops).  ``--trace`` (implies
+lost outside the counted cross-respawn drops).  ``--sessions`` soaks
+the SESSION-SERVING tier (r2d2_tpu/serving, no trainer involved):
+rounds of synthetic episodic load with ``kill_session_client`` +
+``slow_session_client`` armed and an LRU budget below the offered
+session count; every round must keep the tier ``ok``/``degraded``
+(never 503-failing), reap every disconnect's sessions (no leaked
+hidden slots — the reap counter must cover the kills' abandons),
+keep the accounting invariant ``admitted == completed + reaped +
+evicted + live``, and keep completing sessions while a straggler is
+frozen; every other round restarts the server through the session
+snapshot (save → restore) and the counters must carry over.
+``--trace`` (implies
 --process) adds a tracing round: once the first round has seen a
 kill_fleet fire, a cross-process capture window is armed mid-soak over
 /tracez, and the round fails unless the dump parses as Chrome trace
@@ -54,6 +65,7 @@ SERVE = "--serve" in _argv
 ANAKIN = "--anakin" in _argv
 SHARDS = "--shards" in _argv
 TRACE = "--trace" in _argv
+SESSIONS = "--sessions" in _argv
 PROCESS = "--process" in _argv or SERVE or TRACE
 OUT = None
 if "--out" in _argv:
@@ -125,6 +137,139 @@ def _check_trace_dump(ck_dir: str, pre_existing):
         return ("trace dump has no events from a respawned fleet "
                 "incarnation (tid >= 1)")
     return None
+
+
+def session_soak() -> int:
+    """--sessions: soak the session-serving tier (module docstring) —
+    load-gen rounds with client-kill/straggler chaos against a tight LRU
+    budget, a save→restore server restart every other round, and the
+    tier's invariants asserted per round."""
+    import threading
+
+    from r2d2_tpu.analysis import preflight
+
+    preflight(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import session_load_gen as slg
+
+    from r2d2_tpu.checkpoint import Checkpointer
+    from r2d2_tpu.config import test_config
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.serving import SessionServer
+    from r2d2_tpu.utils.chaos import ChaosInjector
+    from r2d2_tpu.utils.supervisor import Supervisor
+
+    A = 4
+    cfg = test_config(serve_max_sessions=48, serve_max_batch=16,
+                      serve_session_idle_s=3.0,
+                      serve_request_deadline=5.0)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    deadline = time.time() + MINUTES * 60
+    rounds, failures = [], []
+    seed = int(time.time()) & 0xFFFF
+    with tempfile.TemporaryDirectory() as ck_dir:
+        ckpt = Checkpointer(ck_dir)
+        server = None
+        rnd = 0
+        while time.time() < deadline:
+            rnd += 1
+            restarted = False
+            if server is None:
+                server = SessionServer(cfg, A)
+                server.publish_params(params)
+                server.warmup()
+                server.start()
+            elif rnd % 2 == 0:
+                # restart drill: snapshot the live store, bring a fresh
+                # server up from it — counters must carry over so the
+                # accounting invariant spans the restart
+                before = server.store.counts()
+                server.stop()
+                server.close()            # drain loops BEFORE state()
+                server.save_sessions(ckpt)
+                server = SessionServer(cfg, A)
+                server.publish_params(params)
+                server.restore_sessions(ckpt)
+                server.start()
+                after = server.store.counts()
+                restarted = True
+                if after != before:
+                    failures.append(
+                        f"round {rnd}: restart dropped counters "
+                        f"{before} -> {after}")
+            chaos = ChaosInjector(
+                "kill_session_client:every=150,n=1000000"
+                ";slow_session_client:every=211,dur=0.8,n=1000000",
+                seed=seed + rnd)
+            out: list = []
+            sup = Supervisor(max_restarts=0)
+            srv = server
+
+            def _round(out=out, srv=srv, chaos=chaos, rnd=rnd):
+                out.append(slg.run_load(
+                    cfg, A, srv.host, srv.port, sessions=80, workers=4,
+                    steps_mean=8, think_s=0.005,
+                    run_seconds=min(25.0, max(5.0,
+                                              deadline - time.time())),
+                    call_timeout=20.0, seed=seed + rnd, chaos=chaos))
+
+            sup.start(f"session_round_{rnd}", _round)
+            worst = "ok"
+            round_deadline = time.time() + 120.0   # run_load self-bounds
+            while not out and not sup.any_failed \
+                    and time.time() < round_deadline:
+                time.sleep(0.25)
+                status = server.healthz()["status"]
+                if status == "failing":
+                    worst = "failing"
+                elif status == "degraded" and worst == "ok":
+                    worst = "degraded"
+            sup.join_all(timeout=30.0)
+            if not out:
+                failures.append(f"round {rnd}: load-gen round died")
+                break
+            load = out[0]
+            s = server.stats()
+            rec = dict(round=rnd, restarted=restarted, load=load,
+                       server={k: s[k] for k in
+                               ("admitted", "completed", "reaped",
+                                "evicted", "rejected", "expired", "gone",
+                                "batches", "requests", "live")},
+                       worst_health=worst, chaos=chaos.counts())
+            rounds.append(rec)
+            print(json.dumps(rec), flush=True)
+            # invariants a session round must uphold
+            if worst == "failing":
+                failures.append(f"round {rnd}: tier went 503-failing")
+            if s["admitted"] != (s["completed"] + s["reaped"]
+                                 + s["evicted"] + s["live"]):
+                failures.append(f"round {rnd}: accounting broken {s}")
+            kills = chaos.counts().get("kill_session_client", 0)
+            if kills and load["abandoned"] and s["reaped"] == 0:
+                failures.append(
+                    f"round {rnd}: {kills} client kills abandoned "
+                    f"{load['abandoned']} sessions but nothing reaped — "
+                    "leaked hidden slots")
+            if load["completed"] == 0:
+                failures.append(f"round {rnd}: no session ever completed")
+            if load["workers_failed"]:
+                failures.append(f"round {rnd}: load-gen worker crashed")
+        if server is not None:
+            server.stop()
+            server.close()
+    summary = dict(minutes=MINUTES, mode="sessions", rounds=len(rounds),
+                   failures=failures,
+                   final=rounds[-1]["server"] if rounds else None)
+    print(json.dumps(summary, indent=2))
+    if OUT:
+        with open(OUT, "w") as f:
+            json.dump(dict(summary=summary, rounds=rounds), f, indent=2)
+    if failures:
+        print("CHAOS SOAK FAILED", file=sys.stderr)
+        return 1
+    print("chaos soak clean")
+    return 0
 
 
 def main() -> int:
@@ -360,4 +505,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(session_soak() if SESSIONS else main())
