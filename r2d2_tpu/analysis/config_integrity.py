@@ -21,6 +21,7 @@ defines ``Config`` (so fixture snippets exercise check 1 alone).
 from __future__ import annotations
 
 import ast
+import json
 import re
 from typing import Dict, List, Set, Tuple
 
@@ -30,6 +31,91 @@ RULE = "config-integrity"
 
 # attribute names every dataclass instance has; never worth flagging
 _DATACLASS_ATTRS = {"replace", "__post_init__", "__dataclass_fields__"}
+
+# --- population_spec JSON validation (r2d2_tpu/league, docs/LEAGUE.md) ----
+# Inline population specs (a string literal bound to a ``population_spec``
+# keyword or assignment) are config too: a misspelled member knob must
+# fail lint, not silently no-op at 3 a.m.  The member-object vocabulary is
+# restated here rather than imported — the analyzer is pure-stdlib AST and
+# must not execute repo code; tests/test_league.py pins these against
+# config.POPULATION_META_KEYS / POPULATION_MEMBER_FIELDS /
+# POPULATION_PRESETS so the two can never drift.
+_POPULATION_KEY = "population_spec"
+_POPULATION_META_KEYS = {"name", "preset"}
+_POPULATION_PRESETS = {"default", "low_resource"}
+_POPULATION_MEMBER_FIELDS = {
+    "game_name", "seed", "base_eps", "eps_alpha",
+    "gamma", "max_episode_steps", "actor_update_interval",
+    "test_epsilon", "eval_episodes", "noop_max",
+}
+
+
+def _population_spec_literals(tree: ast.AST):
+    """(spec string, line) for every inline ``population_spec`` literal:
+    keyword arguments (``Config(population_spec="[...]")``, ``replace``/
+    preset kwargs) and plain assignments.  Specs built dynamically or
+    passed through variables are runtime-validation territory
+    (config.parse_population)."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.keyword)
+                and node.arg == _POPULATION_KEY
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            yield node.value.value, node.value.lineno
+        elif isinstance(node, ast.Assign):
+            if (any(isinstance(t, ast.Name) and t.id == _POPULATION_KEY
+                    for t in node.targets)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                yield node.value.value, node.value.lineno
+
+
+def _check_population_spec(spec: str, fields: Set[str], rel: str,
+                           line: int) -> List[Finding]:
+    """Validate one inline spec against the Config schema — the lint
+    twin of ``config.parse_population`` (structure + key resolution;
+    value-range checks stay runtime-only)."""
+    out: List[Finding] = []
+    if not spec:
+        return out   # "" = population disabled, the default
+    try:
+        raw = json.loads(spec)
+    except ValueError as e:
+        return [Finding(RULE, rel, line,
+                        f"population_spec literal is not valid JSON "
+                        f"({e})")]
+    if not isinstance(raw, list):
+        return [Finding(RULE, rel, line,
+                        "population_spec must be a JSON list of member "
+                        "objects")]
+    for i, m in enumerate(raw):
+        if not isinstance(m, dict):
+            out.append(Finding(RULE, rel, line,
+                               f"population member {i} is not a JSON "
+                               "object"))
+            continue
+        preset = m.get("preset", "default")
+        if preset not in _POPULATION_PRESETS:
+            out.append(Finding(
+                RULE, rel, line,
+                f"population member {i}: unknown preset {preset!r} "
+                f"(expected one of {sorted(_POPULATION_PRESETS)})"))
+        for k in m:
+            if k in _POPULATION_META_KEYS:
+                continue
+            if k not in fields:
+                out.append(Finding(
+                    RULE, rel, line,
+                    f"population member {i} key {k!r} does not resolve "
+                    "to a Config field (typo or removed knob?)"))
+            elif k not in _POPULATION_MEMBER_FIELDS:
+                out.append(Finding(
+                    RULE, rel, line,
+                    f"population member {i} key {k!r} is not "
+                    "population-overridable (members share the "
+                    "learner's network/replay geometry — see "
+                    "config.POPULATION_MEMBER_FIELDS)"))
+    return out
 
 
 def _is_config_receiver(node: ast.AST) -> bool:
@@ -94,6 +180,14 @@ def check_config_integrity(ctx: Context) -> List[Finding]:
                 RULE, mod.rel, line,
                 f"{kind} {name!r} does not resolve to a Config "
                 "field/property (typo or removed knob?)"))
+        # inline population specs validate against the same schema —
+        # a misspelled member knob is a finding, not a silent no-op
+        # (config.py itself is exempt: POPULATION_PRESETS et al. are
+        # the vocabulary's definition site, not a user spec)
+        if not is_config_mod:
+            for spec, line in _population_spec_literals(mod.tree):
+                findings.extend(_check_population_spec(
+                    spec, schema.fields, mod.rel, line))
         if is_config_mod:
             continue
         for node in ast.walk(mod.tree):

@@ -28,6 +28,7 @@ _PRESETS = {
     "hard_exploration": config_mod.hard_exploration_config,
     "atari57": config_mod.atari57_config,
     "impala_deep": config_mod.impala_deep_config,
+    "low_resource": config_mod.low_resource_config,
     "test": config_mod.test_config,
 }
 
@@ -166,6 +167,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "inference (circuit breaker, "
                          "utils/resilience.py); overrides "
                          "cfg.act_response_timeout (must be > 0)")
+    pt.add_argument("--population", default=None, metavar="JSON",
+                    help="population plane (r2d2_tpu/league, "
+                         "docs/LEAGUE.md): a JSON list of per-member "
+                         "config overrides, one process fleet per "
+                         "member, e.g. '[{\"name\": \"base\"}, "
+                         "{\"preset\": \"low_resource\"}]' — member "
+                         "keys validate against the Config schema "
+                         "(POPULATION_MEMBER_FIELDS); requires "
+                         "--actor-transport process with actor_fleets "
+                         "== member count; overrides "
+                         "cfg.population_spec")
+    pt.add_argument("--league-eval", action="store_true", default=None,
+                    help="attach the standing evaluation sidecar "
+                         "(league/eval_service.py): a supervised "
+                         "subprocess follows this run's checkpoints, "
+                         "scores every population member on held-out "
+                         "scenario suites (league_eval_episodes per "
+                         "member), and publishes "
+                         "<ckpt-dir>/telemetry/league.jsonl plus the "
+                         "/statusz league table and league.* metrics; "
+                         "its death degrades /healthz, never training; "
+                         "overrides cfg.league_eval (poll cadence "
+                         "league_eval_interval, per-sweep budget "
+                         "league_eval_deadline)")
     pt.add_argument("--replay-shards", type=int, default=None, metavar="K",
                     help="shard the host replay plane across K owner "
                          "processes (parallel/replay_shards.py): ingest "
@@ -223,6 +248,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "server wrote at shutdown, resuming mid-episode "
                          "sessions bit-exact (clients reconnect and "
                          "continue by session id)")
+    pv.add_argument("--follow", action="store_true",
+                    help="follow-mode serving: track a live trainer's "
+                         "checkpoints in --ckpt-dir (the eval sidecar's "
+                         "follow loop, serving/server.py) and republish "
+                         "each new complete step's params through the "
+                         "ContinuousBatcher — arch-compat-checked, and "
+                         "under serve_dtype=bfloat16 the greedy-parity "
+                         "gate re-runs per republish (a failing step is "
+                         "skipped, serving stays on the last good "
+                         "params).  Waits for the first checkpoint if "
+                         "none exists yet")
     pv.add_argument("--max-wall-seconds", type=float, default=None)
     pv.add_argument("--quiet", action="store_true")
 
@@ -287,6 +323,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cfg = cfg.replace(replay_shards=args.replay_shards)
             if args.sharding_table is not None:
                 cfg = cfg.replace(sharding_table=args.sharding_table)
+            if args.population is not None:
+                cfg = cfg.replace(population_spec=args.population)
+            if args.league_eval:
+                cfg = cfg.replace(league_eval=True)
         except ValueError as e:
             parser.error(str(e))
         if args.sync and args.max_wall_seconds is not None:
@@ -338,6 +378,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             cfg, args.ckpt_dir, action_dim=args.action_dim,
             resume_sessions=args.resume_sessions,
             max_wall_seconds=args.max_wall_seconds,
+            follow=args.follow,
             verbose=not args.quiet)
         print(json.dumps({k: v for k, v in summary.items()
                           if isinstance(v, (int, float, str))}))

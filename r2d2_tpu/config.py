@@ -9,8 +9,9 @@ a ``Config`` explicitly.
 from __future__ import annotations
 
 import dataclasses
+import json
 import re
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 # the canonical learner-mesh axes, in mesh order (parallel/mesh.py's AXES
 # aliases this — defined here so Config validation needs no jax import);
@@ -94,6 +95,129 @@ def parse_table(spec: str) -> Dict[str, Tuple[Optional[str], ...]]:
     return out
 
 
+# --- population / league (r2d2_tpu/league, docs/LEAGUE.md) ----------------
+# JSON member-object keys that are population metadata, not Config
+# overrides.  Restated in r2d2_tpu/analysis/config_integrity.py for the
+# jax-free lint pass — tests/test_league.py pins the two in sync.
+POPULATION_META_KEYS = ("name", "preset")
+
+# Config fields one population member may override.  A deliberate
+# WHITELIST, not a blacklist: every member's blocks flow into ONE shared
+# replay plane and act on ONE learner's params, so anything that changes
+# parameter shapes (checkpoint.ARCH_FIELDS), the block wire format /
+# replay geometry (block_length, learning_steps, burn_in_steps, obs
+# layout), or the fabric topology must stay base-config-owned.  What
+# remains is the scenario-diversity axis: the env, the exploration
+# ladder, the discount (gamma is pure per-block DATA — n_step_reward /
+# n_step_gamma carry it through the wire, the learner never reads
+# cfg.gamma), and eval-side knobs.  ``forward_steps`` is deliberately
+# NOT here: the learner's target gather bootstraps at the BASE config's
+# n (learner/step._window_indices), so a member with a smaller n would
+# pair an n'-step reward sum with Q(s_{t+n}) — a silently biased
+# Bellman target.  Per-member n-step needs a per-row n word through the
+# batch wire (ring accounting + shard RPC + in-graph meta) and is an
+# explicit follow-on (docs/LEAGUE.md).  Restated in
+# analysis/config_integrity.py (pinned by tests/test_league.py).
+POPULATION_MEMBER_FIELDS = (
+    "game_name", "seed", "base_eps", "eps_alpha",
+    "gamma", "max_episode_steps", "actor_update_interval",
+    "test_epsilon", "eval_episodes", "noop_max",
+)
+
+# named member presets a population_spec entry may start from
+# ("preset": "low_resource"); explicit member keys override preset keys.
+# "low_resource" is the acting-side slice of low_resource_config (the
+# "Human-Level Control without Server-Grade Hardware" recipe, PAPERS.md)
+# — the net/replay knobs of that preset are base-config territory.
+# Preset names are restated in analysis/config_integrity.py (pinned).
+POPULATION_PRESETS: Dict[str, Dict[str, Any]] = {
+    "default": {},
+    # NOTE: low_resource_config's forward_steps=3 does NOT ride the
+    # member preset — per-member n-step is whitelisted out (see
+    # POPULATION_MEMBER_FIELDS); the discount/exploration slice does
+    "low_resource": dict(gamma=0.99, base_eps=0.3, eps_alpha=5.0),
+}
+
+MAX_POPULATION_MEMBERS = 64
+
+
+def parse_population(spec: str) -> List[Dict[str, Any]]:
+    """``cfg.population_spec`` JSON → normalized member list
+    ``[{name, preset, overrides}, ...]``.
+
+    The spec is a JSON list of member objects; each object holds optional
+    ``name``/``preset`` metadata plus Config-field overrides drawn from
+    :data:`POPULATION_MEMBER_FIELDS`.  Raises ``ValueError`` on malformed
+    JSON, an unknown preset, a key that is not a Config field (typo), or
+    a real field that is not population-overridable — misspelled member
+    knobs fail at Config construction (and in graftlint's
+    config-integrity pass), never silently no-op.  Value types are
+    coerced to the field's declared default type so ``"forward_steps":
+    3.0`` from hand-written JSON cannot smuggle a float into an int knob.
+    """
+    try:
+        raw = json.loads(spec)
+    except ValueError as e:
+        raise ValueError(f"population_spec is not valid JSON: {e}")
+    if not isinstance(raw, list) or not raw:
+        raise ValueError(
+            "population_spec must be a non-empty JSON list of member "
+            "objects, e.g. '[{\"name\": \"base\"}, "
+            "{\"preset\": \"low_resource\"}]'")
+    if len(raw) > MAX_POPULATION_MEMBERS:
+        raise ValueError(
+            f"population_spec declares {len(raw)} members "
+            f"(max {MAX_POPULATION_MEMBERS})")
+    fields = Config.__dataclass_fields__
+    out: List[Dict[str, Any]] = []
+    for i, m in enumerate(raw):
+        if not isinstance(m, dict):
+            raise ValueError(
+                f"population member {i} must be a JSON object, got "
+                f"{type(m).__name__}")
+        preset = m.get("preset", "default")
+        if preset not in POPULATION_PRESETS:
+            raise ValueError(
+                f"population member {i}: unknown preset {preset!r} "
+                f"(expected one of {tuple(POPULATION_PRESETS)})")
+        name = m.get("name", preset if preset != "default" else f"m{i}")
+        if not isinstance(name, str) or not name:
+            raise ValueError(
+                f"population member {i}: 'name' must be a non-empty "
+                "string")
+        overrides = dict(POPULATION_PRESETS[preset])
+        for k, v in m.items():
+            if k in POPULATION_META_KEYS:
+                continue
+            if k not in fields:
+                raise ValueError(
+                    f"population member {i} ({name}): {k!r} is not a "
+                    "Config field (typo or removed knob?)")
+            if k not in POPULATION_MEMBER_FIELDS:
+                raise ValueError(
+                    f"population member {i} ({name}): {k!r} is not "
+                    "population-overridable — members share the "
+                    "learner's network, replay geometry and fabric "
+                    "topology (overridable: "
+                    f"{POPULATION_MEMBER_FIELDS})")
+            default = fields[k].default
+            if isinstance(default, bool):
+                overrides[k] = bool(v)
+            elif isinstance(default, int):
+                overrides[k] = int(v)
+            elif isinstance(default, float):
+                overrides[k] = float(v)
+            else:
+                overrides[k] = v
+        out.append(dict(name=name, preset=preset, overrides=overrides))
+    names = [m["name"] for m in out]
+    if len(set(names)) != len(names):
+        raise ValueError(
+            f"population member names must be unique, got {names} — "
+            "names label league.jsonl rows and population.* metrics")
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class Config:
     # --- environment -----------------------------------------------------
@@ -162,6 +286,50 @@ class Config:
     # --- evaluation -------------------------------------------------------
     test_epsilon: float = 0.001  # reference: config.py:37
     eval_episodes: int = 5       # reference: test.py:17
+
+    # --- population / league (r2d2_tpu/league, docs/LEAGUE.md) -----------
+    population_spec: str = ""         # JSON list of per-member overrides
+                                      # generalizing the per-actor epsilon
+                                      # ladder to per-fleet member
+                                      # CONFIGURATIONS (env, epsilon
+                                      # ladder, n-step, discount — the
+                                      # scenario-diversity axis): one
+                                      # fleet subprocess per member, each
+                                      # acting under base.replace(
+                                      # **member overrides), blocks
+                                      # member-tagged through the shm
+                                      # wire into the shared replay
+                                      # plane.  Keys validate against
+                                      # POPULATION_MEMBER_FIELDS at
+                                      # construction (and in graftlint);
+                                      # requires actor_transport=
+                                      # "process" with actor_fleets ==
+                                      # member count.  "" = no
+                                      # population (the degenerate
+                                      # single-member run)
+    league_eval: bool = False         # attach the standing EvalSidecar
+                                      # (league/eval_service.py): a
+                                      # supervised subprocess follows the
+                                      # run's checkpoints, scores every
+                                      # population member on its held-out
+                                      # scenario suite, and publishes
+                                      # league.jsonl + the /statusz
+                                      # league table + league.* metrics.
+                                      # Its death degrades /healthz —
+                                      # training never stops for eval
+    league_eval_episodes: int = 3     # rollouts per (checkpoint, member)
+                                      # eval — the held-out suite size
+    league_eval_interval: float = 2.0  # sidecar checkpoint-poll cadence
+                                      # in seconds (the follow loop's
+                                      # idle wait)
+    league_eval_deadline: float = 120.0  # per-sweep time budget: a sweep
+                                      # (all members on one checkpoint)
+                                      # that blows it yields mid-step and
+                                      # resumes the remaining members
+                                      # next poll — a slow suite can lag
+                                      # the trainer but never wedge the
+                                      # sidecar on one checkpoint (0 =
+                                      # unbounded)
 
     # --- TPU-native knobs (no reference equivalent) -----------------------
     compute_dtype: str = "bfloat16"   # activations dtype for conv/matmul
@@ -680,6 +848,34 @@ class Config:
         if self.trace_steps < 0:
             raise ValueError("trace_steps must be >= 0 (0 = no boot-time "
                              "capture; /tracez arms one on demand)")
+        if self.league_eval_episodes < 1:
+            raise ValueError("league_eval_episodes must be >= 1")
+        if self.league_eval_interval <= 0:
+            raise ValueError(
+                "league_eval_interval must be > 0 (the sidecar's "
+                "checkpoint poll cadence)")
+        if self.league_eval_deadline < 0:
+            raise ValueError(
+                "league_eval_deadline must be >= 0 (0 = unbounded)")
+        if self.population_spec:
+            members = parse_population(self.population_spec)
+            if self.actor_transport != "process":
+                raise ValueError(
+                    "population_spec requires actor_transport='process' "
+                    "— members run as fleet subprocesses, one per "
+                    "member (the thread/anakin transports have no "
+                    "per-fleet config axis)")
+            if len(members) != self.actor_fleets:
+                raise ValueError(
+                    f"population_spec declares {len(members)} members "
+                    f"but actor_fleets={self.actor_fleets} — one fleet "
+                    "per member; set actor_fleets to the member count")
+            for m in members:
+                # full member-config validation: every override
+                # combination must itself construct (epsilon/knob
+                # ranges all re-checked through this same __post_init__)
+                dataclasses.replace(self, population_spec="",
+                                    **m["overrides"])
         if self.chaos_spec:
             # fail at construction, not mid-run: parse_spec raises on an
             # unknown kind/param or a clause without a trigger
@@ -790,6 +986,26 @@ def impala_deep_config(game: str = "MsPacman", **kw) -> Config:
     )
     base.update(kw)
     return Config(**base)
+
+
+def low_resource_config(game: str = "MsPacman", **kw) -> Config:
+    """Workstation-scale R2D2 after "Human-Level Control without
+    Server-Grade Hardware" (PAPERS.md): a smaller recurrent net, a
+    shorter replay ring, fewer actors and a shorter n-step/discount
+    horizon, tuned for a single commodity host instead of a pod.  Also
+    the base config the ``low_resource`` population-member preset slices
+    its acting-side knobs from (POPULATION_PRESETS — a member may only
+    override the scenario axis; the net/replay shrinkage here applies
+    when the preset is the RUN's base config)."""
+    base = dict(
+        game_name=game, num_actors=16, env_workers=4, actor_fleets=2,
+        hidden_dim=256, batch_size=32,
+        buffer_capacity=500_000, learning_starts=20_000,
+        block_length=200, burn_in_steps=20, learning_steps=40,
+        forward_steps=3, gamma=0.99, base_eps=0.3, eps_alpha=5.0,
+    )
+    base.update(kw)
+    return Config(**_clamp_fleets(base, kw))
 
 
 def test_config(**kw) -> Config:

@@ -1,0 +1,36 @@
+"""Population training plane + standing evaluation service (ROADMAP 4).
+
+The per-actor epsilon ladder is a degenerate population: one config
+axis, one measurement of nothing.  This package generalizes it:
+
+- :mod:`~r2d2_tpu.league.population` — ``cfg.population_spec`` resolved
+  into per-fleet member configurations (env, epsilon ladder, n-step,
+  discount — the scenario-diversity axis), one fleet subprocess per
+  member, member-tagged blocks flowing into the shared replay plane.
+- :mod:`~r2d2_tpu.league.scenarios` — held-out evaluation suites per
+  member: seeded FakeAtariEnv variants plus any jittable env through
+  the ``envs/anakin.py`` four-method surface (a gym-5-tuple adapter).
+- :mod:`~r2d2_tpu.league.eval_service` — the :class:`EvalSidecar`: a
+  supervised subprocess that follows the run's checkpoints
+  (``Learner._save``'s skip-complete discipline makes the follow read
+  torn-free), scores every member per checkpoint, and publishes
+  durable ``league.jsonl`` rows + the ``/statusz`` league table +
+  ``league.*`` metrics.  Its death degrades ``/healthz``; training
+  never stops for evaluation.
+
+See docs/LEAGUE.md for the spec format, lifecycle and failure modes.
+"""
+from r2d2_tpu.league.population import (
+    Member,
+    build_members,
+    population_epsilons,
+)
+from r2d2_tpu.league.eval_service import EvalSidecar, league_table
+
+__all__ = [
+    "EvalSidecar",
+    "Member",
+    "build_members",
+    "league_table",
+    "population_epsilons",
+]

@@ -1,0 +1,377 @@
+"""The standing evaluation service: a checkpoint-following eval sidecar.
+
+The Podracer paper's standing-eval pattern (PAPERS.md): training never
+stops to measure itself — a *sidecar* follows the run's checkpoints and
+scores policies continuously.  Two halves:
+
+- :func:`_sidecar_main` — the subprocess body.  CPU-pinned (it must
+  never touch the trainer's accelerator), it polls the run's
+  ``Checkpointer`` in follow mode (complete steps only — the meta
+  sidecar commits last, and ``Learner._save``'s skip-complete discipline
+  means a live saver never rewrites a step under this reader), restores
+  each new checkpoint ONCE, and runs batched lockstep rollouts per
+  population member on that member's held-out scenario suite
+  (league/scenarios.py).  Every (checkpoint, member) score appends one
+  JSON line to ``<ckpt_dir>/telemetry/league.jsonl`` (run-log
+  conventions: append-on-resume, torn-line-tolerant readers, size-capped
+  rotation) — the durable league record.  A respawned sidecar reads that
+  file first and resumes the checkpoint cursor exactly where its dead
+  predecessor stopped: no duplicate rows, no skipped members.  Each
+  sweep (one checkpoint, all members) is deadline-bounded
+  (``cfg.league_eval_deadline``): a slow suite yields mid-step and the
+  remaining members resume next poll.
+- :class:`EvalSidecar` — the trainer-side supervisor: spawn, a watchdog
+  (``eval_watch`` fabric loop) that respawns a dead sidecar up to its
+  restart budget, the league-table aggregation for ``/statusz`` and the
+  ``league.*`` registry namespace.  An exhausted budget marks the
+  sidecar ``failed`` — which **degrades** ``/healthz`` (HTTP 200) and
+  nothing else: evaluation is never allowed to stop training.
+"""
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.telemetry.registry import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+LEAGUE_FILENAME = "league.jsonl"
+
+
+def league_path(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, "telemetry", LEAGUE_FILENAME)
+
+
+def read_league(checkpoint_dir: str) -> List[Dict[str, Any]]:
+    """Every league row on disk, oldest first, across rotated segments;
+    torn final lines (a SIGKILLed sidecar mid-append) are skipped."""
+    from r2d2_tpu.telemetry.runlog import read_entries
+
+    return list(read_entries(league_path(checkpoint_dir)))
+
+
+def league_table(entries: List[Dict[str, Any]],
+                 num_members: Optional[int] = None) -> Dict[str, Any]:
+    """Aggregate league rows into the standings the operator reads.
+
+    Returns ``table`` (one row per member — latest and best scores,
+    ranked best-first), ``sweeps`` (checkpoints every member has been
+    scored on — the "sweep complete" unit), ``last_step`` and ``rows``.
+    ``num_members`` pins the sweep-completeness denominator (a member
+    that has not scored yet must hold sweeps at 0); defaults to the
+    members observed in the rows.
+    """
+    per: Dict[int, Dict[str, Any]] = {}
+    covered: Dict[int, set] = {}
+    total = 0
+    for e in entries:
+        if e.get("kind") != "eval":
+            continue
+        total += 1
+        m = int(e["member"])
+        r = per.get(m)
+        if r is None:
+            r = per[m] = dict(member=m, name=e.get("member_name", ""),
+                              game=e.get("game", ""), evals=0,
+                              last_step=-1, last_reward=0.0,
+                              best_step=-1, best_reward=None)
+        r["evals"] += 1
+        step, reward = int(e["step"]), float(e["mean_reward"])
+        if step >= r["last_step"]:
+            r["last_step"], r["last_reward"] = step, reward
+        if r["best_reward"] is None or reward > r["best_reward"]:
+            r["best_step"], r["best_reward"] = step, reward
+        covered.setdefault(step, set()).add(m)
+    n = num_members if num_members is not None else len(per)
+    sweeps = (sum(1 for ms in covered.values() if len(ms) >= n)
+              if n else 0)
+    table = sorted(per.values(),
+                   key=lambda r: (-(r["best_reward"]
+                                    if r["best_reward"] is not None
+                                    else float("-inf")), r["member"]))
+    return dict(table=table, sweeps=sweeps, rows=total,
+                last_step=max(covered) if covered else -1)
+
+
+# --------------------------------------------------------------------------
+# the sidecar subprocess
+# --------------------------------------------------------------------------
+
+def _sidecar_main(cfg: Config, checkpoint_dir: str, action_dim: int,
+                  stop_event, incarnation: int = 0,
+                  run_once: bool = False) -> None:
+    """Sidecar body (module-level: spawn-picklable).  ``run_once=True``
+    drains every currently-pending (checkpoint, member) pair and returns
+    — the in-process mode tests (and cursor-resume drills) drive."""
+    if not run_once:
+        import jax
+
+        # the sidecar must never attach to the trainer's accelerator;
+        # eval batches are (episodes,)-lane acts a CPU serves fine
+        jax.config.update("jax_platforms", "cpu")
+
+    from r2d2_tpu.checkpoint import Checkpointer, check_arch_compat
+    from r2d2_tpu.actor import make_act_fn
+    from r2d2_tpu.evaluate import run_episodes
+    from r2d2_tpu.league.population import build_members
+    from r2d2_tpu.league.scenarios import (
+        HELD_OUT_SEED_BASE,
+        close_suite,
+        member_suite,
+    )
+    from r2d2_tpu.models.network import create_network
+    from r2d2_tpu.telemetry.runlog import RunLog, read_entries
+    from r2d2_tpu.utils.resilience import Deadline
+
+    ckpt = Checkpointer(checkpoint_dir)
+    members = build_members(cfg)
+    net = create_network(cfg, action_dim)
+    # one jitted act twin for every member (arch fields are population-
+    # invariant); the eval batch shape is (league_eval_episodes, ...) so
+    # the budget is one deliberate trace (+ first-call wobble)
+    act_fn = make_act_fn(cfg, net, retrace_name="league.act")
+    path = league_path(checkpoint_dir)
+    # the checkpoint cursor IS the league file: a respawn re-reads it and
+    # never re-scores a (step, member) pair its predecessor committed
+    scored = {(int(e["step"]), int(e["member"]))
+              for e in read_entries(path) if e.get("kind") == "eval"}
+    skipped: set = set()   # arch-incompatible steps, never retried
+    restore_failures: Dict[int, int] = {}   # transient-vs-doomed steps
+    lg = RunLog(os.path.dirname(path), filename=LEAGUE_FILENAME,
+                max_bytes=cfg.telemetry_log_max_bytes)
+
+    def pending() -> Dict[int, List[Any]]:
+        by_step: Dict[int, List[Any]] = {}
+        for step in ckpt.steps():      # complete steps only (meta-gated)
+            if step in skipped:
+                continue
+            todo = [m for m in members
+                    if (step, m.member_id) not in scored]
+            if todo:
+                by_step[step] = todo
+        return by_step
+
+    try:
+        while not stop_event.is_set():
+            by_step = pending()
+            for step in sorted(by_step):
+                if stop_event.is_set():
+                    break
+                # per-sweep budget: a slow suite yields and resumes the
+                # remaining members next poll (run_once: unbounded — the
+                # caller asked for a full drain)
+                deadline = Deadline(0.0 if run_once
+                                    else cfg.league_eval_deadline)
+                meta = ckpt.peek_meta(step)
+                try:
+                    check_arch_compat(cfg, meta)
+                except ValueError as e:
+                    log.warning("league: step %d skipped (%s)", step, e)
+                    skipped.add(step)
+                    continue
+                try:
+                    raw, _ = ckpt.restore(None, step=step)
+                except Exception as e:
+                    # GC'd under us is a transient race (the step drops
+                    # out of steps() next poll); a PERSISTENTLY torn
+                    # payload with a committed sidecar is not — without
+                    # a retry bound it would re-restore at poll speed
+                    # forever (and spin run_once flat out).  Three
+                    # strikes, then the step is skipped like an
+                    # arch-incompatible one.
+                    n = restore_failures[step] = (
+                        restore_failures.get(step, 0) + 1)
+                    log.warning("league: step %d restore failed "
+                                "(attempt %d/3: %s)", step, n, e)
+                    if run_once or n >= 3:
+                        skipped.add(step)
+                    continue
+                params = raw["params"]
+                for m in by_step[step]:
+                    if stop_event.is_set() or deadline.expired:
+                        break
+                    envs = member_suite(m.cfg, m.member_id,
+                                        cfg.league_eval_episodes,
+                                        action_dim)
+                    # exploration stream deterministic per (step, member)
+                    # so a respawned sidecar re-running an uncommitted
+                    # eval reproduces it exactly
+                    rng = np.random.default_rng(
+                        [HELD_OUT_SEED_BASE, m.member_id, step])
+                    try:
+                        returns = run_episodes(
+                            m.cfg, net, params, envs,
+                            epsilon=m.cfg.test_epsilon, rng=rng,
+                            act_fn=act_fn)
+                    finally:
+                        # one suite per (checkpoint, member) forever:
+                        # unclosed real-ALE emulators would accumulate
+                        # until the sidecar OOMs
+                        close_suite(envs)
+                    lg.append(dict(
+                        kind="eval", time=time.time(), step=int(step),
+                        member=m.member_id, member_name=m.name,
+                        game=m.cfg.game_name, episodes=len(returns),
+                        mean_reward=float(np.mean(returns)),
+                        env_frames=(int(meta.get("env_steps", 0))
+                                    * cfg.frameskip),
+                        minutes=float(meta.get("minutes", 0.0)),
+                        incarnation=int(incarnation)))
+                    scored.add((step, m.member_id))
+            if run_once:
+                if not pending():
+                    return
+                continue
+            stop_event.wait(cfg.league_eval_interval)
+    finally:
+        lg.close()
+
+
+# --------------------------------------------------------------------------
+# trainer-side supervision
+# --------------------------------------------------------------------------
+
+class EvalSidecar:
+    """Spawns and supervises the eval sidecar subprocess.
+
+    Lifecycle mirrors the fleet plane's: :meth:`start` spawns,
+    :meth:`make_loops` returns the supervised ``eval_watch`` loop
+    (respawn-with-cursor-resume up to ``max_restarts``; an exhausted
+    budget sets :attr:`failed` — /healthz degrades, training is never
+    touched), :meth:`shutdown` stops the child.  :meth:`status` is the
+    league table the log loop embeds in its entries (→ /statusz) and the
+    telemetry plane absorbs as ``league.*`` metrics.
+    """
+
+    def __init__(self, cfg: Config, checkpoint_dir: str, action_dim: int,
+                 registry: Optional[MetricsRegistry] = None,
+                 max_restarts: int = 3):
+        from r2d2_tpu.league.population import build_members
+
+        self.cfg = cfg
+        self.checkpoint_dir = checkpoint_dir
+        self.action_dim = action_dim
+        self.registry = registry if registry is not None else (
+            MetricsRegistry())
+        self.max_restarts = max_restarts
+        self.num_members = len(build_members(cfg))
+        self.ctx = mp.get_context("spawn")
+        self.proc: Optional[mp.Process] = None
+        self._child_stop = None   # the live child's private poll event
+        self.restarts = 0
+        self.failed = False
+        self._stopping = False
+        self._table_ts = 0.0
+        self._table: Dict[str, Any] = league_table([], self.num_members)
+
+    # ------------------------------------------------------------ lifecycle
+    def _spawn(self) -> None:
+        # the stop event is SPAWN-PRIVATE and the trainer NEVER calls
+        # set()/wait()/is_set() on it: a SIGKILLed child (the
+        # kill_eval_sidecar chaos drill) can die holding the event's
+        # internal lock — the documented mp caveat the fleet plane's
+        # channel retirement exists for — and any trainer-side
+        # operation on that corrupted primitive would hang the teardown
+        # forever (observed as a wedged chaos soak).  Stop is therefore
+        # SIGTERM (:meth:`shutdown`); the event only gives the child
+        # its poll sleep, each incarnation gets a fresh one, and the
+        # parent merely HOLDS the reference so the semaphore survives
+        # until the child has rebuilt it.  (A SIGTERM mid-append at
+        # worst tears league.jsonl's final line — readers skip it and
+        # the uncommitted eval simply re-runs, deterministically, on
+        # the next spawn.)
+        self._child_stop = self.ctx.Event()
+        self.proc = self.ctx.Process(
+            target=_sidecar_main, name="eval_sidecar",
+            args=(self.cfg, self.checkpoint_dir, self.action_dim,
+                  self._child_stop, self.restarts),
+            daemon=True)
+        self.proc.start()
+
+    def start(self) -> None:
+        self._spawn()
+
+    def watch_once(self) -> int:
+        """Respawn a dead sidecar (cursor resumes from league.jsonl).
+        Returns restarts performed.  An exhausted budget sets
+        :attr:`failed` — deliberately NO raise: a dead evaluator must
+        degrade /healthz, never stop the training fabric."""
+        if self._stopping or self.failed:
+            return 0
+        p = self.proc
+        if p is None or p.is_alive():
+            return 0
+        if self.restarts >= self.max_restarts:
+            self.failed = True
+            log.error(
+                "eval sidecar died (exitcode %s) with its restart "
+                "budget (%d) exhausted — league evaluation STOPS; "
+                "training continues, /healthz degrades", p.exitcode,
+                self.max_restarts)
+            return 0
+        self.restarts += 1
+        self.registry.inc("league.sidecar_respawns")
+        log.warning(
+            "eval sidecar died (exitcode %s) — respawn %d/%d; the "
+            "checkpoint cursor resumes from league.jsonl", p.exitcode,
+            self.restarts, self.max_restarts)
+        self._spawn()
+        return 1
+
+    def make_loops(self, stop):
+        """The supervised watchdog loop for ``train()``'s fabric."""
+
+        def eval_watch():
+            while not stop():
+                self.watch_once()
+                time.sleep(0.25)
+
+        return [("eval_watch", eval_watch)]
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """SIGTERM → join → SIGKILL.  Deliberately no shared stop flag
+        toward the child (see :meth:`_spawn`): every step of this path
+        is a kernel call that cannot block on a lock a killed child may
+        have corrupted."""
+        self._stopping = True
+        p = self.proc
+        if p is not None:
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout)
+            if p.is_alive():
+                p.kill()
+                p.join(2.0)
+
+    # ---------------------------------------------------------------- state
+    def health(self) -> Dict[str, Any]:
+        alive = self.proc is not None and self.proc.is_alive()
+        return dict(alive=alive, restarts=self.restarts,
+                    failed=self.failed,
+                    # dead-now (pre-respawn window) or failed-for-good:
+                    # either way the run is blind to policy quality —
+                    # degraded, not failing
+                    degraded=self.failed or not alive)
+
+    def status(self, max_age: float = 1.0) -> Dict[str, Any]:
+        """League standings + sidecar health (the log-loop entry /
+        /statusz payload).  The table re-reads league.jsonl at most once
+        per ``max_age`` seconds — rows arrive at checkpoint cadence, not
+        scrape cadence."""
+        now = time.monotonic()
+        if now - self._table_ts > max_age:
+            self._table_ts = now
+            try:
+                self._table = league_table(
+                    read_league(self.checkpoint_dir), self.num_members)
+            except OSError:
+                pass   # keep the previous standings on a racing rotate
+        return dict(self._table, health=self.health(),
+                    members=self.num_members)

@@ -1,0 +1,106 @@
+"""Population plane: per-fleet member configurations.
+
+``cfg.population_spec`` (grammar + validation in config.py, the
+``parse_table`` precedent — Config validation stays jax-free) declares N
+members; this module resolves them into the objects the fabric consumes:
+
+- :class:`Member` — ``(member_id, name, cfg)`` where ``cfg`` is the BASE
+  config with the member's whitelisted overrides applied
+  (``config.POPULATION_MEMBER_FIELDS``; anything that would change param
+  shapes, the block wire format or the fabric topology is rejected at
+  Config construction).
+- :func:`build_members` — the spec → ``[Member]`` resolution, including
+  the wire-format invariance belt: every member's block slot layout must
+  be byte-identical to the base's (guaranteed by the whitelist, asserted
+  anyway — a torn channel is the worst silent failure this plane could
+  ship).
+- :func:`population_epsilons` — the global lane-aligned epsilon list:
+  member f owns fleet f's contiguous lane shard (``actor.fleet_shards``,
+  the one split definition), and its lanes run the member's OWN ladder
+  (``epsilon_ladder(i, lanes, member.base_eps, member.eps_alpha)``) — the
+  per-actor ladder generalized to a per-member ladder slice.
+
+Members map 1:1 onto process fleets in declaration order (Config
+validation pins ``actor_fleets == len(members)``), so the existing fleet
+machinery — shm block channels, stats slab + CounterMerger, watchdog
+respawns, chaos kills — carries the population for free; the only new
+wire state is the block's ``member_id`` word (replay/block.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+from r2d2_tpu.config import Config, parse_population
+
+
+@dataclasses.dataclass(frozen=True)
+class Member:
+    """One population member, resolved against the base config."""
+
+    member_id: int
+    name: str
+    preset: str
+    cfg: Config                      # base.replace(**overrides)
+    overrides: Dict[str, Any]        # the applied override dict
+
+
+def build_members(cfg: Config) -> List[Member]:
+    """Resolve ``cfg.population_spec`` into :class:`Member` objects.
+
+    Returns the single base-config member for an empty spec (the
+    degenerate population — callers need no branch).  Raises
+    ``ValueError`` (via ``parse_population`` / member ``replace``
+    validation) exactly where Config construction would.
+    """
+    if not cfg.population_spec:
+        return [Member(0, "base", "default", cfg, {})]
+    out = []
+    for i, m in enumerate(parse_population(cfg.population_spec)):
+        mcfg = cfg.replace(population_spec="", **m["overrides"])
+        out.append(Member(i, m["name"], m["preset"], mcfg,
+                          dict(m["overrides"])))
+    return out
+
+
+def assert_wire_compatible(cfg: Config, members: List[Member],
+                           action_dim: int) -> None:
+    """Belt over the override whitelist: every member's block slot
+    layout must be byte-identical to the base's — the fleet-side
+    producer serialises under the member config while the trainer-side
+    channel was laid out under the base, and a divergence would be a
+    silently torn transport, the one failure mode worse than a crash."""
+    from r2d2_tpu.replay.block import block_slot_spec, slot_layout
+
+    base = slot_layout(block_slot_spec(cfg, action_dim))
+    for m in members:
+        got = slot_layout(block_slot_spec(m.cfg, action_dim))
+        if got != base:
+            raise ValueError(
+                f"population member {m.member_id} ({m.name}) changes the "
+                "block wire layout — overrides "
+                f"{sorted(m.overrides)} must not touch replay geometry "
+                "(this should have been caught by "
+                "POPULATION_MEMBER_FIELDS; report it)")
+
+
+def population_epsilons(cfg: Config, members: List[Member]) -> List[float]:
+    """The global lane-aligned epsilon list for a population run: fleet
+    f's contiguous lane shard carries member f's own ladder.  Degenerate
+    single-member populations reproduce the global ladder exactly."""
+    from r2d2_tpu.actor import fleet_shards
+    from r2d2_tpu.utils.math import epsilon_ladder
+
+    shards, _ = fleet_shards(cfg)
+    if len(shards) != len(members):
+        raise ValueError(
+            f"{len(shards)} fleet shards for {len(members)} members — "
+            "Config validation should have pinned actor_fleets to the "
+            "member count")
+    out: List[float] = []
+    for m, (lo, hi) in zip(members, shards):
+        lanes = hi - lo
+        out.extend(
+            epsilon_ladder(i, lanes, m.cfg.base_eps, m.cfg.eps_alpha)
+            for i in range(lanes))
+    return out

@@ -1,0 +1,141 @@
+"""Held-out evaluation scenario suites for the league's eval sidecar.
+
+Training acts on seeds ``cfg.seed + lane`` (plus respawn-incarnation
+offsets); a standing evaluation that reused those streams would score
+memorization of the training trajectories.  This module builds each
+member's suite from a disjoint seed plane:
+
+- **Seeded FakeAtariEnv variants** — ``create_env`` under the member's
+  own config (so a member's ``game_name``/``noop_max`` overrides shape
+  its suite) at ``HELD_OUT_SEED_BASE``-offset seeds the training fleet
+  can never draw.
+- **Any jittable env** — :class:`JittableEnvAdapter` wraps the
+  ``envs/anakin.py`` four-method surface (``init_state`` / ``observe`` /
+  ``step`` / ``reset_lanes``) into the gym 5-tuple single-env API the
+  batched evaluator (:func:`r2d2_tpu.evaluate.run_episodes`) consumes,
+  so every env that earned the anakin fast path is an eval scenario for
+  free.  The fake suites include one adapter lane as the standing proof
+  of that claim.
+
+Suites are deterministic per (member, episode index): a respawned
+sidecar re-evaluating a checkpoint member reproduces the same episodes.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from r2d2_tpu.config import Config
+
+# seed plane disjoint from training's cfg.seed + lane (+ incarnation
+# multiples of 1_000_003): a large odd offset per member keeps member
+# suites disjoint from each other too
+HELD_OUT_SEED_BASE = 0x5EED_0E7A
+
+
+class _Discrete:
+    """Minimal action-space shim (``.n`` + ``sample``) for the adapter."""
+
+    def __init__(self, n: int, seed: int):
+        self.n = int(n)
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self) -> int:
+        return int(self._rng.integers(self.n))
+
+
+class JittableEnvAdapter:
+    """gym-5-tuple shim over the ``envs/anakin.py`` four-method surface.
+
+    Drives ONE lane of a jittable env through host-side dispatches:
+    ``reset`` draws a fresh state via ``init_state``, ``step`` applies
+    the in-graph dynamics and reports ``truncated`` from the env's own
+    mask.  ``terminated`` is always False — the four-method surface
+    encodes episode ends as truncation (the anakin loop's contract).
+    Per-step jax dispatch makes this an *evaluation* adapter, not a
+    training transport; the fused loop is where jittable envs earn
+    their keep.
+    """
+
+    def __init__(self, env: Any, seed: int = 0):
+        import jax
+
+        if env.num_lanes != 1:
+            raise ValueError("the eval adapter drives one lane "
+                             f"(env has {env.num_lanes})")
+        self.env = env
+        self.action_space = _Discrete(env.action_dim, seed)
+        self.observation_space = None  # unused by the evaluator
+        self._key = jax.random.PRNGKey(seed)
+        self._state = None
+
+    def reset(self, *, seed=None, **kwargs):
+        import jax
+
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+        self._key, sub = jax.random.split(self._key)
+        self._state = self.env.init_state(sub)
+        obs = np.asarray(self.env.observe(self._state))[0]
+        return obs, {}
+
+    def step(self, action: int):
+        import jax.numpy as jnp
+
+        if self._state is None:
+            raise RuntimeError("step before reset")
+        self._state, reward, truncated = self.env.step(
+            self._state, jnp.asarray([int(action)], jnp.int32))
+        obs = np.asarray(self.env.observe(self._state))[0]
+        return (obs, float(np.asarray(reward)[0]), False,
+                bool(np.asarray(truncated)[0]), {})
+
+    def close(self) -> None:
+        pass
+
+
+def member_suite(mcfg: Config, member_id: int, episodes: int,
+                 action_dim: int) -> List[Any]:
+    """The held-out env list for one (member, sweep) evaluation —
+    ``episodes`` lockstep lanes, seeds disjoint from training's.
+
+    When the member's env resolves to the fake path the last lane is a
+    :class:`JittableEnvAdapter` over the pure-JAX ``AnakinFakeEnv``
+    (same dynamics, bit-exact per tests/test_anakin.py — the jittable
+    surface exercised through the evaluator); real-ALE members get all
+    lanes from ``create_env``.
+    """
+    from r2d2_tpu.envs import atari_available, create_env
+
+    base = HELD_OUT_SEED_BASE + 7_368_787 * member_id
+    fake = (mcfg.game_name == "Fake") or not atari_available()
+    envs: List[Any] = [
+        create_env(mcfg, noop_start=True, seed=base + i)
+        for i in range(episodes)
+    ]
+    if fake and episodes > 1:
+        from r2d2_tpu.envs.anakin import AnakinFakeEnv
+
+        probe = envs.pop()
+        envs.append(JittableEnvAdapter(
+            AnakinFakeEnv(obs_shape=mcfg.stored_obs_shape,
+                          action_dim=probe.action_space.n,
+                          episode_len=probe.episode_len, num_lanes=1),
+            seed=base + episodes - 1))
+        close_suite([probe])   # replaced, not kept: must not leak
+    return envs
+
+
+def close_suite(envs: List[Any]) -> None:
+    """Close every env of a suite — the sidecar evaluates one suite per
+    (checkpoint, member) for the life of the run, and unclosed real-ALE
+    emulators would accumulate file descriptors/memory in the long-lived
+    subprocess until it OOMs."""
+    for e in envs:
+        try:
+            close = getattr(e, "close", None)
+            if callable(close):
+                close()
+        except Exception:
+            pass

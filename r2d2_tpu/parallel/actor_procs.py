@@ -194,9 +194,11 @@ class ShmBlockProducer:
     never hangs a fleet mid-block (raises :class:`FleetStopped`)."""
 
     def __init__(self, cfg: Config, action_dim: int,
-                 info: Tuple[str, Any, Any], stop_event, src: int = 0):
+                 info: Tuple[str, Any, Any], stop_event, src: int = 0,
+                 member_id: int = 0):
         name, self.free, self.ready = info
         self.src = src
+        self.member_id = member_id   # population member tag (league/)
         # NOTE: attaching registers the segment with the resource tracker
         # a second time; that is a set-dedup no-op because fleet children
         # are spawned via mp.Process and share the trainer's tracker —
@@ -235,6 +237,10 @@ class ShmBlockProducer:
                 continue
         views = slot_views(self.shm.buf, self.spec, self.offsets,
                            self.slot_nbytes, slot)
+        # member tag rides the wire next to the lineage stamps, so every
+        # downstream hop (ingest, replay stats, shard routing) can count
+        # per-member experience flow without a fleet→member side table
+        block.member_id = self.member_id
         k, n_obs, n_steps = write_block(views, block, priorities)
         self.ready.put((slot, self.src, k, n_obs, n_steps, episode_reward))
         self.blocks_sent += 1
@@ -264,6 +270,10 @@ class _FleetSpec:
                             # must not replay its predecessor's env seeds
                             # and exploration stream (near-duplicate
                             # trajectories into the PER buffer)
+    member_id: int = 0      # population member this fleet acts for
+                            # (league/population.py; fleet f ↔ member f,
+                            # 0 for non-population runs) — stamps every
+                            # block's member_id wire word
 
 
 def _decode_pump(payload: bytes):
@@ -399,7 +409,8 @@ def _fleet_worker_main(cfg: Config, action_dim: int, env_factory,
         act_fn = make_act_fn(cfg, net)
 
     producer = ShmBlockProducer(cfg, action_dim, producer_info, stop_event,
-                                src=spec.fleet_id)
+                                src=spec.fleet_id,
+                                member_id=spec.member_id)
     stats_writer = (StatsSlabWriter(stats_info)
                     if stats_info is not None else None)
     if trace_info is not None:
@@ -530,7 +541,8 @@ class ProcessFleetPlane:
     SLOTS_PER_FLEET = 4   # in-flight blocks per fleet channel
 
     def __init__(self, cfg: Config, action_dim: int, env_factory,
-                 epsilons: Sequence[float], max_restarts: int = 3):
+                 epsilons: Sequence[float], max_restarts: int = 3,
+                 members: Optional[Sequence[Any]] = None):
         from r2d2_tpu.actor import fleet_shards
 
         self.cfg = cfg
@@ -539,10 +551,29 @@ class ProcessFleetPlane:
         self.max_restarts = max_restarts
         self.ctx = mp.get_context("spawn")
 
+        # population plane (league/population.py): member f owns fleet f
+        # — its fleet subprocess acts under the MEMBER config (env,
+        # epsilon ladder, n-step, discount) while the channel/slab wire
+        # stays laid out under the base config (asserted byte-identical:
+        # the override whitelist forbids geometry changes)
+        self.members = list(members) if members else []
+        if self.members:
+            from r2d2_tpu.league.population import assert_wire_compatible
+
+            if len(self.members) != cfg.actor_fleets:
+                raise ValueError(
+                    f"{len(self.members)} population members for "
+                    f"{cfg.actor_fleets} fleets — one fleet per member")
+            assert_wire_compatible(cfg, self.members, action_dim)
+        self.fleet_cfgs = ([m.cfg for m in self.members] if self.members
+                           else [cfg] * cfg.actor_fleets)
+
         shards, fleet_workers = fleet_shards(cfg)
         self.specs = [
             _FleetSpec(f, lo, hi, tuple(float(e) for e in epsilons[lo:hi]),
-                       fleet_workers)
+                       fleet_workers,
+                       member_id=(self.members[f].member_id
+                                  if self.members else 0))
             for f, (lo, hi) in enumerate(shards)
         ]
         F = len(self.specs)
@@ -573,6 +604,12 @@ class ProcessFleetPlane:
         self.channels: List[Optional[ShmBlockChannel]] = [None] * F
         self._graveyard: List[ShmBlockChannel] = []
         self.stop_event = self.ctx.Event()
+        # trainer-side mirror of the stop flag: a SIGKILLed fleet child
+        # can die holding the shared event's internal lock, after which
+        # ANY trainer-side is_set()/set() on it can block forever — so
+        # trainer logic reads this plain bool and shutdown() writes the
+        # event through utils.resilience.bounded_event_set only
+        self._stopping = False
         self.weight_queues: List[Any] = [None] * F
         self.ctrl_queues: List[Any] = [None] * F   # snapshot requests out
         self.snap_queues: List[Any] = [None] * F   # snapshots back
@@ -770,7 +807,12 @@ class ProcessFleetPlane:
                 name=f"fleet{f}")
         p = self.ctx.Process(
             target=_fleet_worker_main, name=f"fleet{f}",
-            args=(self.cfg, self.action_dim, self.env_factory, spec,
+            # the MEMBER config under a population (league/population.py
+            # — same base otherwise): the worker's envs, epsilon ladder
+            # and block math run member-shaped, while the channel above
+            # stays base-laid-out (wire-compat asserted at construction)
+            args=(self.fleet_cfgs[f], self.action_dim, self.env_factory,
+                  spec,
                   self.channels[f].producer_info(), self.weight_queues[f],
                   self.stop_event, self.ctrl_queues[f], self.snap_queues[f],
                   restore_snap, act_info, self.stats_slab.writer_info(f),
@@ -816,7 +858,11 @@ class ProcessFleetPlane:
         after marking the plane failed — once a fleet exhausts its
         budget, so the supervised watchdog escalates to a fabric stop."""
         restarted = 0
-        if self.stop_event.is_set():
+        # the trainer-local mirror, NOT stop_event.is_set(): a fleet
+        # SIGKILLed while holding the shared event's lock (kill_fleet
+        # chaos) would wedge this watchdog — and the whole fabric —
+        # forever on the read
+        if self._stopping:
             return 0
         for f, p in enumerate(self.procs):
             if p is None or p.is_alive():
@@ -1043,6 +1089,33 @@ class ProcessFleetPlane:
         loops.append(("fleet_watch", fleet_watch))
         return loops
 
+    def population_health(self, stats: Optional[dict] = None
+                          ) -> Optional[dict]:
+        """Per-member view of the slab-merged fleet counters (fleet f ↔
+        member f): env steps, blocks produced/ingested, episodes, reward
+        sum — the ``population.*`` telemetry rows.  None outside a
+        population run."""
+        if not self.members:
+            return None
+        stats = stats if stats is not None else self.poll_fleet_stats()
+        rows = []
+        for f, m in enumerate(self.members):
+            row = (stats["per_fleet"][f]
+                   if f < len(stats["per_fleet"]) else {})
+            rows.append(dict(
+                member=m.member_id, name=m.name, preset=m.preset,
+                game=m.cfg.game_name,
+                lanes=self.specs[f].hi - self.specs[f].lo,
+                env_steps=int(row.get("env_steps", 0)),
+                blocks=int(row.get("blocks_produced", 0)),
+                blocks_ingested=int(self.blocks_per_fleet[f]),
+                episodes=int(row.get("episodes", 0)),
+                episode_reward_sum=float(
+                    row.get("episode_reward_sum", 0.0)),
+                param_version=int(row.get("param_version", 0)),
+            ))
+        return dict(members=rows)
+
     def health(self) -> dict:
         stats = self.poll_fleet_stats()
         out = dict(
@@ -1058,6 +1131,9 @@ class ProcessFleetPlane:
             stats=stats,
             resilience=self.resilience_health(stats),
         )
+        pop = self.population_health(stats)
+        if pop is not None:
+            out["population"] = pop
         if self.service is not None:
             out["service"] = self.service.health()
         return out
@@ -1073,7 +1149,12 @@ class ProcessFleetPlane:
         from the worker's shutdown handshake) and returns the per-fleet
         list (None entries for fleets that died or timed out); otherwise
         returns None."""
-        self.stop_event.set()
+        from r2d2_tpu.utils.resilience import bounded_event_set
+
+        self._stopping = True
+        # bounded: a SIGKILLed child may have corrupted the event's lock
+        # — an abandoned set degrades to the terminate/join reap below
+        bounded_event_set(self.stop_event, name="fleet-stop")
         live = [f for f, p in enumerate(self.procs)
                 if p is not None and p.is_alive()]
         for f in live:

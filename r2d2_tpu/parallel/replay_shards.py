@@ -496,6 +496,12 @@ class ShardedReplayPlane:
             cfg.seed)
 
         self.stop_event = self.ctx.Event()
+        # trainer-side mirror of the stop flag (actor_procs'
+        # ProcessFleetPlane rule): a shard SIGKILLed while holding the
+        # shared event's lock (kill_replay_shard chaos) would wedge any
+        # trainer-side is_set() forever — trainer logic reads this bool,
+        # shutdown() writes the event via bounded_event_set only
+        self._stopping = False
         # serialises respawns: the watch loop and a snapshot writer that
         # found a dead shard must not both spawn a replacement
         self._watch_lock = threading.Lock()
@@ -622,11 +628,17 @@ class ShardedReplayPlane:
         # moment the fabric is up, and without this the spawn warm-up
         # (the child's import) would eat the first blocks' send budgets
         deadline = Deadline(wait_ready)
-        while not deadline.expired and not self.stop_event.is_set():
+        while not deadline.expired and not self._stopping:
             if all(self.stats_slab.read(s) is not None
                    for s in range(self.K)):
                 return
             time.sleep(0.05)
+
+    def _stop_requested(self) -> bool:
+        """The trainer-side stop predicate bounded sends poll — the
+        plain-bool mirror, never the child-shared event (module
+        docstring / ProcessFleetPlane._stopping rule)."""
+        return self._stopping
 
     def watch_once(self) -> int:
         """Respawn any dead shard process (skipped while shutting down).
@@ -634,8 +646,8 @@ class ShardedReplayPlane:
         its restart budget, so the supervised watchdog escalates to a
         fabric stop instead of a silently thinning replay plane."""
         restarted = 0
-        if self.stop_event.is_set():
-            return 0
+        if self._stopping:   # the trainer-local mirror, never the
+            return 0         # possibly-corrupted shared event
         with self._watch_lock:
             for s, p in enumerate(self.procs):
                 if p is None or p.is_alive():
@@ -677,8 +689,13 @@ class ShardedReplayPlane:
         idempotent."""
         if self._closed:
             return
+        from r2d2_tpu.utils.resilience import bounded_event_set
+
         self._closed = True
-        self.stop_event.set()
+        self._stopping = True
+        # bounded: a SIGKILLed shard may have corrupted the event's lock
+        # — an abandoned set degrades to the terminate/join reap below
+        bounded_event_set(self.stop_event, name="replay-stop")
         for p in self.procs:
             if p is None:
                 continue
@@ -724,7 +741,7 @@ class ShardedReplayPlane:
         # priority-independent either way; a concurrent watchdog
         # retirement of `ch` just makes the bounded send fail → drop)
         ok = ch.send_block(block, priorities, episode_reward,
-                           stop=self.stop_event.is_set)
+                           stop=self._stop_requested)
         with self._lock:
             if not ok:
                 # dead/wedged shard: crash-lost experience, bounded wait

@@ -64,9 +64,14 @@ class Block:
     # stamped — one time.time() per block, which feeds the
     # pipeline.block_age_at_train_s decomposition); ``trace_id`` is the
     # nonzero flow id of an armed capture window (0 in steady state —
-    # the capture flag that keeps disarmed overhead at zero)
+    # the capture flag that keeps disarmed overhead at zero);
+    # ``member_id`` is the population member that produced the block
+    # (league/population.py — stamped by the fleet-side producer, 0 for
+    # non-population runs), so per-member experience flow is countable
+    # at every hop (replay stats, population.* telemetry)
     cut_ts: float = 0.0
     trace_id: int = 0
+    member_id: int = 0
 
 
 def assemble_block(cfg: Config, *, obs: np.ndarray, last_action: np.ndarray,
@@ -158,12 +163,14 @@ def block_slot_spec(cfg: Config, action_dim: int):
     return per_block + windows + (
         ("priorities", (cfg.seqs_per_block,), np.float32),
         # block lineage (telemetry/tracing.py): the cut wall-clock stamp
-        # (always written — feeds the pipeline.* latency histograms) and
-        # the capture-window flow id (0 when no capture is armed).
-        # Deliberately OUTSIDE the slot CRC: telemetry, not experience —
-        # a garbled stamp must never cost a valid block
+        # (always written — feeds the pipeline.* latency histograms), the
+        # capture-window flow id (0 when no capture is armed), and the
+        # population member id (league/population.py; 0 outside a
+        # population run).  Deliberately OUTSIDE the slot CRC: telemetry,
+        # not experience — a garbled stamp must never cost a valid block
         ("cut_ts", (1,), np.float64),
         ("trace_id", (1,), np.int64),
+        ("member_id", (1,), np.int64),
         # integrity word: CRC32 over the slot's used payload bytes + the
         # shape header, written LAST by the producer.  A torn write (a
         # producer SIGKILLed mid-slot) or garbled slab shows up as a
@@ -328,6 +335,7 @@ def write_block(views: dict, block: Block, priorities: np.ndarray
     # written so a recycled slot can never leak its previous block's id
     views["cut_ts"][0] = block.cut_ts
     views["trace_id"][0] = block.trace_id
+    views["member_id"][0] = block.member_id
     # CRC last: a slot is only valid once its integrity word matches
     views["crc32"][0] = slot_crc(views, k, n_obs, n_steps)
     return k, n_obs, n_steps
@@ -353,6 +361,7 @@ def read_block(views: dict, k: int, n_obs: int, n_steps: int
         forward_steps=views["forward_steps"][:k],
         cut_ts=float(views["cut_ts"][0]),
         trace_id=int(views["trace_id"][0]),
+        member_id=int(views["member_id"][0]),
     )
     return block, views["priorities"]
 

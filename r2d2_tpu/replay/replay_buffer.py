@@ -207,6 +207,11 @@ class ReplayBuffer:
         self.training_steps = 0
         self.sum_loss = 0.0
         self.corrupt_blocks = 0  # wire-format CRC mismatches, never reset
+        # member-tagged experience flow (league/population.py): blocks
+        # added per Block.member_id — cumulative, telemetry-only (not in
+        # the replay snapshot: a resume recounts from its warm ring's
+        # NEW adds).  {0: n} outside a population run
+        self.blocks_per_member: Dict[int, int] = {}
 
     def __len__(self) -> int:
         return self.size
@@ -326,6 +331,8 @@ class ReplayBuffer:
             self._slot_cut_ts[slot] = block.cut_ts
             self._slot_add_ts[slot] = time.time()
             self._slot_trace[slot] = block.trace_id
+            m = int(block.member_id)
+            self.blocks_per_member[m] = self.blocks_per_member.get(m, 0) + 1
             if episode_reward is not None:
                 self.episode_reward += episode_reward
                 self.num_episodes += 1
@@ -755,6 +762,11 @@ class ReplayBuffer:
                 # schema whether replay is sharded
                 # (parallel/replay_shards.py reports real counts) or not
                 shard_respawns=0,
+                # member-tagged blocks (population runs tag via the wire
+                # format's member_id word; {0: n} otherwise) — the
+                # replay-side proof that every member's experience is
+                # actually flowing
+                blocks_per_member=dict(self.blocks_per_member),
             )
             self.episode_reward = 0.0
             self.num_episodes = 0

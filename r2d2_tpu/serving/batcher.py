@@ -66,6 +66,20 @@ class ContinuousBatcher:
         self._scratch: dict = {}
 
     # ------------------------------------------------------------- params
+    @staticmethod
+    def _quantize(params):
+        """The bf16 weights-only round-trip (mantissa truncation IS the
+        quantization) — shared by :meth:`publish` and the re-runnable
+        :meth:`greedy_parity_ok` gate so the gate tests exactly what
+        publish ships."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16).astype(jnp.float32)
+            if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
+            params)
+
     def publish(self, params) -> int:
         """Adopt a new param snapshot for serving.  ``serve_dtype=
         "bfloat16"`` quantizes every float32 leaf through bfloat16 at
@@ -73,13 +87,9 @@ class ContinuousBatcher:
         stays the executable's own compute dtype), exactly like
         ``param_pump_dtype`` narrows the pump wire."""
         import jax
-        import jax.numpy as jnp
 
         if self.cfg.serve_dtype == "bfloat16":
-            params = jax.tree.map(
-                lambda x: x.astype(jnp.bfloat16).astype(jnp.float32)
-                if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
-                params)
+            params = self._quantize(params)
         # host trees (a checkpoint restore) commit to a local device once
         # per publish, the VectorActor._refresh_params rule
         if isinstance(jax.tree.leaves(params)[0], np.ndarray):
@@ -87,6 +97,36 @@ class ContinuousBatcher:
         self._params = params
         self.version += 1
         return self.version
+
+    def greedy_parity_ok(self, params, probe: int = 32,
+                         seed: int = 0) -> bool:
+        """The greedy-action-parity gate, re-runnable per publish: on a
+        seeded probe batch, the bf16-quantized params must pick the same
+        greedy actions as the full-precision ones.  Follow-mode serving
+        runs this before EVERY republish (a trained policy can drift
+        into bf16-sensitive logit margins long after the initial gate
+        passed); trivially True when ``serve_dtype`` is float32.  The
+        probe batch is bucket-shaped so the gate never costs an extra
+        trace."""
+        if self.cfg.serve_dtype != "bfloat16":
+            return True
+        import jax
+
+        cfg = self.cfg
+        n = self.bucket(min(probe, self.buckets[-1]))
+        rng = np.random.default_rng(seed)
+        obs = rng.integers(0, 256, (n, *cfg.stored_obs_shape), np.uint8)
+        la = np.zeros((n, self.action_dim), np.float32)
+        la[np.arange(n), rng.integers(self.action_dim, size=n)] = 1.0
+        lr = rng.normal(size=n).astype(np.float32)
+        hid = (rng.normal(size=(n, 2, cfg.lstm_layers, cfg.hidden_dim))
+               .astype(np.float32) * 0.1)
+        if isinstance(jax.tree.leaves(params)[0], np.ndarray):
+            params = jax.device_put(params, jax.local_devices()[0])
+        q_ref, _ = self._act(params, obs, la, lr, hid)
+        q_bf16, _ = self._act(self._quantize(params), obs, la, lr, hid)
+        return bool((np.asarray(q_ref).argmax(axis=1)
+                     == np.asarray(q_bf16).argmax(axis=1)).all())
 
     @property
     def ready(self) -> bool:

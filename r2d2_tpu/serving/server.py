@@ -77,7 +77,7 @@ from r2d2_tpu.serving.wire import (
     session_response_spec,
 )
 from r2d2_tpu.telemetry.registry import MetricsRegistry
-from r2d2_tpu.utils.resilience import CLOSED
+from r2d2_tpu.utils.resilience import CLOSED, Deadline
 from r2d2_tpu.utils.supervisor import Supervisor
 from r2d2_tpu.utils.trace import Tracer
 
@@ -569,27 +569,93 @@ class SessionServer:
 # standalone entry point (the `r2d2_tpu serve` CLI)
 # --------------------------------------------------------------------------
 
+def follow_params_once(server: SessionServer, ckpt, cfg: Config,
+                       followed: Dict[str, int]) -> bool:
+    """One poll of follow-mode serving: adjudicate the newest COMPLETE
+    checkpoint past ``followed["step"]`` — arch-compat-check, restore,
+    re-run the bf16 greedy-parity gate, republish through the batcher.
+    A failing gate or a torn/arch-drifted step is SKIPPED (serving stays
+    on the last good params; deterministic verdicts are never retried).
+    Returns True when a republish happened.  ``followed`` carries
+    ``step`` / ``republishes`` / ``parity_failures`` across polls."""
+    from r2d2_tpu.checkpoint import check_arch_compat
+
+    s = ckpt.latest_step()
+    if s is None or s <= followed["step"]:
+        return False
+    try:
+        check_arch_compat(cfg, ckpt.peek_meta(s))
+        raw, _ = ckpt.restore(None, step=s)
+    except Exception as e:  # arch drift / GC'd or torn under us
+        log.warning("serving: follow skipped step %d (%s)", s, e)
+        followed["step"] = s
+        return False
+    new_params = raw["params"]
+    if not server.batcher.greedy_parity_ok(new_params):
+        followed["parity_failures"] += 1
+        followed["step"] = s
+        server.registry.inc("serving.follow_parity_failures")
+        log.error("serving: bf16 greedy-parity gate FAILED for step %d "
+                  "— serving stays on the last good params (version "
+                  "%d)", s, server.batcher.version)
+        return False
+    server.publish_params(new_params)
+    followed["step"] = s
+    followed["republishes"] += 1
+    server.registry.inc("serving.republishes")
+    server.registry.set_gauge("serving.followed_step", float(s))
+    log.info("serving: republished step %d (param version %d)", s,
+             server.batcher.version)
+    return True
+
+
 def run_server(cfg: Config, checkpoint_dir: str,
                action_dim: Optional[int] = None,
                resume_sessions: bool = False,
                max_wall_seconds: Optional[float] = None,
-               verbose: bool = True) -> Dict[str, Any]:
+               verbose: bool = True,
+               follow: bool = False,
+               follow_poll: float = 2.0) -> Dict[str, Any]:
     """Serve the newest complete checkpoint in ``checkpoint_dir`` until
     SIGTERM/SIGINT (drain, snapshot the live sessions, exit) or the wall
     budget.  Returns the final :meth:`SessionServer.stats` plus the
     bound ports — the CLI prints it as the run's machine-readable
-    summary."""
+    summary.
+
+    ``follow=True`` is follow-mode serving (the league eval sidecar's
+    checkpoint-follow loop on the serving tier): a supervised
+    ``param_follow`` loop polls the Checkpointer every ``follow_poll``
+    seconds and republishes each new COMPLETE step's params through the
+    ContinuousBatcher — arch-compat-checked, and under
+    ``serve_dtype="bfloat16"`` the greedy-parity gate re-runs per
+    republish (:meth:`ContinuousBatcher.greedy_parity_ok`; a failing
+    step is skipped and serving stays on the last good params).  With no
+    checkpoint on disk yet, follow mode waits for the first one instead
+    of failing — `r2d2_tpu serve --follow` can start before its
+    trainer."""
     import signal
 
-    from r2d2_tpu.checkpoint import Checkpointer
+    from r2d2_tpu.checkpoint import Checkpointer, check_arch_compat
 
     ckpt = Checkpointer(checkpoint_dir)
     step = ckpt.latest_step()
-    if step is None:
+    if step is None and not follow:
         raise FileNotFoundError(
             f"no complete checkpoint under {checkpoint_dir} — train "
-            "first, then serve")
-    from r2d2_tpu.checkpoint import check_arch_compat
+            "first, then serve (or --follow a live trainer)")
+    # follow-mode cold start: the trainer may not have saved yet.  The
+    # wait gets its OWN bound — the serving wall budget starts after
+    # warmup (below), exactly as in non-follow mode, so restore/compile
+    # time never eats a short --max-wall-seconds serving window
+    wait = Deadline(max_wall_seconds if max_wall_seconds else 0.0)
+    while step is None:
+        if wait.expired:
+            raise FileNotFoundError(
+                f"no complete checkpoint appeared under {checkpoint_dir} "
+                "within the wall budget (--follow waits for a live "
+                "trainer's first save)")
+        time.sleep(0.5)
+        step = ckpt.latest_step()
 
     meta = ckpt.peek_meta(step)
     check_arch_compat(cfg, meta)   # fail with a field list, not an orbax
@@ -617,6 +683,15 @@ def run_server(cfg: Config, checkpoint_dir: str,
                 prev[sig] = signal.signal(sig, _on_signal)
             except (ValueError, OSError):
                 pass
+    # follow-mode state: the last step adjudicated (published OR skipped
+    # by a parity failure — a deterministic gate is never retried)
+    followed = dict(step=int(step), republishes=0, parity_failures=0)
+
+    def param_follow():
+        while not (stop.is_set() or server._stop()):
+            time.sleep(follow_poll)
+            follow_params_once(server, ckpt, cfg, followed)
+
     try:
         server.publish_params(params)
         server.warmup()
@@ -624,12 +699,15 @@ def run_server(cfg: Config, checkpoint_dir: str,
             server.restore_sessions(ckpt)
         for name, loop in server.exporter_loops(cfg.telemetry_port):
             server.supervisor.start(name, loop)
+        if follow:
+            server.supervisor.start("param_follow", param_follow)
         server.start()
         if verbose:
             print(f"serving step_{step} on {server.host}:{server.port} "
                   f"(dtype={cfg.serve_dtype}, "
                   f"max_sessions={cfg.serve_max_sessions}, "
-                  f"max_batch={cfg.serve_max_batch})", flush=True)
+                  f"max_batch={cfg.serve_max_batch}"
+                  + (", follow" if follow else "") + ")", flush=True)
         deadline = (time.monotonic() + max_wall_seconds
                     if max_wall_seconds else None)
         last_line = 0.0
@@ -666,4 +744,8 @@ def run_server(cfg: Config, checkpoint_dir: str,
                 pass
     out = dict(server.stats(), step=int(step), port=server.port,
                health=final_health)
+    if follow:
+        out.update(followed_step=followed["step"],
+                   republishes=followed["republishes"],
+                   follow_parity_failures=followed["parity_failures"])
     return out

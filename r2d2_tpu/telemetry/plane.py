@@ -165,6 +165,26 @@ class Telemetry:
                               svc.get("last_batch_lanes", 0))
                 reg.set_gauge("serve.param_version",
                               svc.get("param_version", 0))
+            # population plane (league/population.py): per-member rows of
+            # the slab-merged fleet counters — fleet f ↔ member f, folded
+            # monotone through respawns by the CounterMerger upstream
+            pop = fleet.get("population")
+            if pop:
+                for row in pop.get("members", []):
+                    lbl = str(row.get("member", 0))
+                    reg.counter_max("population.env_steps",
+                                    row.get("env_steps", 0), member=lbl)
+                    reg.counter_max("population.blocks",
+                                    row.get("blocks", 0), member=lbl)
+                    reg.counter_max("population.episodes",
+                                    row.get("episodes", 0), member=lbl)
+                    # reward sums legally decrease (negative rewards):
+                    # gauge, the actor.episode_reward_sum rule
+                    reg.set_gauge("population.episode_reward_sum",
+                                  row.get("episode_reward_sum", 0.0),
+                                  member=lbl)
+                    reg.set_gauge("population.lanes",
+                                  row.get("lanes", 0), member=lbl)
             # degraded-mode resilience plane (utils/resilience.py): the
             # fleets' act-RPC failover state merged from the stats slab
             # plus the plane's param-staleness watchdog
@@ -216,6 +236,31 @@ class Telemetry:
         if "corrupt_blocks" in entry:
             reg.counter_max("replay.corrupt_blocks",
                             entry["corrupt_blocks"])
+        # league standings (league/eval_service.py): the sidecar's
+        # durable record is league.jsonl; these gauges are the scrape
+        # view — per-member latest/best scores plus sidecar liveness.
+        # sidecar_respawns is inc'd at the respawn event site (the
+        # fleet.respawns rule), so it is deliberately NOT re-absorbed
+        lg = entry.get("league")
+        if lg:
+            h = lg.get("health") or {}
+            reg.set_gauge("league.sidecar_alive",
+                          1.0 if h.get("alive") else 0.0)
+            reg.set_gauge("league.sidecar_failed",
+                          1.0 if h.get("failed") else 0.0)
+            reg.counter_max("league.rows", lg.get("rows", 0))
+            reg.counter_max("league.sweeps", lg.get("sweeps", 0))
+            reg.set_gauge("league.last_step",
+                          max(0, lg.get("last_step", 0)))
+            for row in lg.get("table", []):
+                lbl = str(row.get("member", 0))
+                reg.counter_max("league.evals", row.get("evals", 0),
+                                member=lbl)
+                reg.set_gauge("league.last_reward",
+                              row.get("last_reward", 0.0), member=lbl)
+                if row.get("best_reward") is not None:
+                    reg.set_gauge("league.best_reward",
+                                  row["best_reward"], member=lbl)
         # anakin fused-loop surface (train._train_anakin's log loop): the
         # transport is single-process by construction, so its counters
         # publish straight through the registry — no shm slab involved

@@ -254,13 +254,45 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
     buffer.env_steps = start_env_steps
     epsilons = [epsilon_ladder(i, cfg.num_actors, cfg.base_eps, cfg.eps_alpha)
                 for i in range(cfg.num_actors)]
+    members = None
+    if cfg.population_spec:
+        # population plane (league/population.py; Config validation
+        # already pinned actor_transport="process" and one fleet per
+        # member): member configs resolve here, the global epsilon list
+        # becomes per-member ladder slices, and every member env is
+        # probed for action-space parity — one Q-head serves the whole
+        # population, so a member env with a different action set is a
+        # config error, not a runtime shape crash
+        from r2d2_tpu.league.population import (
+            build_members,
+            population_epsilons,
+        )
+
+        members = build_members(cfg)
+        epsilons = population_epsilons(cfg, members)
+        for m in members:
+            if m.cfg.game_name == cfg.game_name:
+                continue
+            probe = env_factory(m.cfg, m.cfg.seed)
+            member_dim = probe.action_space.n
+            try:
+                probe.close()
+            except Exception:
+                pass
+            if member_dim != action_dim:
+                raise ValueError(
+                    f"population member {m.member_id} ({m.name}): env "
+                    f"{m.cfg.game_name!r} has action_dim {member_dim} "
+                    f"but the base env has {action_dim} — one Q-head "
+                    "serves the whole population")
     plane = None
     if cfg.actor_transport == "process":
         # subprocess fleets (parallel/actor_procs): constructed here, but
         # processes only spawn in train() once the fabric is up
         from r2d2_tpu.parallel.actor_procs import ProcessFleetPlane
 
-        plane = ProcessFleetPlane(cfg, action_dim, env_factory, epsilons)
+        plane = ProcessFleetPlane(cfg, action_dim, env_factory, epsilons,
+                                  members=members)
         actors: List[VectorActor] = []
     else:
         act_fn = make_act_fn(cfg, net)
@@ -351,8 +383,13 @@ class _HostScaffold:
                  max_wall_seconds: Optional[float] = None,
                  max_thread_restarts: int = 3,
                  signal_msg: str = "draining fabric, then saving full state",
-                 watch_label: str = "learner"):
+                 watch_label: str = "learner",
+                 stop_fn: Optional[Callable[[], bool]] = None):
         self.cfg = cfg
+        # optional caller-provided stop predicate (embedders, tests, the
+        # sweep driver): polled alongside the event/deadline/supervisor
+        # checks — a programmatic drain-then-save without a signal
+        self._stop_fn = stop_fn
         self.checkpoint_dir = checkpoint_dir
         self.telemetry = Telemetry(cfg, checkpoint_dir)
         # on-demand capture plane (telemetry/tracing.py), armed by
@@ -390,7 +427,8 @@ class _HostScaffold:
     def stop(self) -> bool:
         return (self.stop_event.is_set() or self.supervisor.any_failed
                 or (self.deadline is not None
-                    and time.time() > self.deadline))
+                    and time.time() > self.deadline)
+                or (self._stop_fn is not None and self._stop_fn()))
 
     def install_signals(self) -> None:
         """SIGTERM/SIGINT request a drain-then-save shutdown.  Signals
@@ -565,7 +603,11 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     cfg = cfg.replace(prefetch_batches=0, env_workers=0, actor_fleets=1,
                       device_replay=False, in_graph_per=False,
                       superstep_pipeline=0, actor_transport="thread",
-                      actor_inference="local", replay_shards=1)
+                      actor_inference="local", replay_shards=1,
+                      # population members are process fleets and the
+                      # eval sidecar is a fabric subprocess — neither
+                      # exists in the deterministic single-thread path
+                      population_spec="", league_eval=False)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     cfg = sys["cfg"]
     actor: VectorActor = sys["actor"]
@@ -606,7 +648,9 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                   verbose: bool = True,
                   log_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
                   tracer: Optional[Tracer] = None,
-                  profile_dir: Optional[str] = None) -> Dict[str, Any]:
+                  profile_dir: Optional[str] = None,
+                  stop_fn: Optional[Callable[[], bool]] = None
+                  ) -> Dict[str, Any]:
     """``actor_transport="anakin"``: the whole training loop — pure-JAX
     batched env, in-graph actor, in-graph replay writes, train steps —
     is one jitted program (the Podracer "Anakin" architecture,
@@ -701,7 +745,7 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
         cfg, checkpoint_dir, max_wall_seconds=max_wall_seconds,
         signal_msg="draining the anakin loop, then saving full "
                    "on-device state",
-        watch_label="anakin loop")
+        watch_label="anakin loop", stop_fn=stop_fn)
     telemetry, supervisor = scaffold.telemetry, scaffold.supervisor
     heartbeat, stall, logs = (scaffold.heartbeat, scaffold.stall,
                               scaffold.logs)
@@ -836,7 +880,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
           log_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
           tracer: Optional[Tracer] = None,
           profile_dir: Optional[str] = None,
-          max_thread_restarts: int = 3) -> Dict[str, Any]:
+          max_thread_restarts: int = 3,
+          stop_fn: Optional[Callable[[], bool]] = None) -> Dict[str, Any]:
     """The full concurrent system (reference train.py:20-44 equivalent).
 
     Threads and their reference analogues:
@@ -896,11 +941,20 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 "jittable and v1 ships only the pure-JAX fake env "
                 "(envs/anakin.py; episode length via "
                 "cfg.anakin_episode_len)", stacklevel=2)
+        if cfg.league_eval:
+            import warnings
+
+            warnings.warn(
+                "league_eval is not wired into the anakin transport "
+                "(the fused loop has its own on-device eval-lane "
+                "follow-on, ROADMAP item 2) — running without the eval "
+                "sidecar", stacklevel=2)
         return _train_anakin(cfg, checkpoint_dir=checkpoint_dir,
                              resume=resume, use_mesh=use_mesh,
                              max_wall_seconds=max_wall_seconds,
                              verbose=verbose, log_sink=log_sink,
-                             tracer=tracer, profile_dir=profile_dir)
+                             tracer=tracer, profile_dir=profile_dir,
+                             stop_fn=stop_fn)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     cfg = sys["cfg"]  # the EFFECTIVE config (degrade paths flip flags)
     actors: List[VectorActor] = sys["actors"]
@@ -912,7 +966,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     tracer = tracer or Tracer()
     scaffold = _HostScaffold(cfg, checkpoint_dir,
                              max_wall_seconds=max_wall_seconds,
-                             max_thread_restarts=max_thread_restarts)
+                             max_thread_restarts=max_thread_restarts,
+                             stop_fn=stop_fn)
     telemetry, supervisor = scaffold.telemetry, scaffold.supervisor
     heartbeat, stall, logs = (scaffold.heartbeat, scaffold.stall,
                               scaffold.logs)
@@ -979,6 +1034,24 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         if want_full_save:
             replay_plane.checkpointer = checkpointer
         replay_plane.chaos = chaos
+
+    # standing evaluation sidecar (league/eval_service.py): follows this
+    # run's checkpoints from a supervised subprocess, scores every
+    # population member on its held-out suite, publishes league.jsonl +
+    # the /statusz league table.  Its death only ever DEGRADES /healthz
+    # — the watchdog loop respawns it (cursor resumed from league.jsonl)
+    # and an exhausted budget stops evaluation, never training.
+    sidecar = None
+    if cfg.league_eval:
+        if checkpoint_dir is None:
+            log.warning("league_eval requested without a checkpoint_dir "
+                        "— the eval sidecar follows checkpoints; "
+                        "running without it")
+        else:
+            from r2d2_tpu.league.eval_service import EvalSidecar
+
+            sidecar = EvalSidecar(cfg, checkpoint_dir, sys["action_dim"],
+                                  registry=telemetry.registry)
 
     def learner_stop() -> bool:
         if chaos is not None:
@@ -1097,6 +1170,14 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             # a dead shard mid-respawn: the plane keeps serving from the
             # survivors (redistributed strata) — degraded, not failing
             degraded = degraded or bool(rh["degraded"])
+        if sidecar is not None:
+            lh = sidecar.health()
+            out["league"] = lh
+            # a dead/failed evaluator blinds the run to policy quality
+            # but touches nothing on the training path: degraded, never
+            # failing — an orchestrator must not evict a training run
+            # because its scoreboard died
+            degraded = degraded or bool(lh["degraded"])
         out["degraded"] = degraded and out["ok"]
         out["status"] = ("failing" if not out["ok"]
                          else "degraded" if degraded else "ok")
@@ -1133,6 +1214,11 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 entry["fleet"] = plane.health()
             if replay_plane is not None:
                 entry["replay_shards"] = replay_plane.health()
+            if sidecar is not None:
+                # the league standings ride the entry → /statusz
+                # last_entry + the JSONL run log + the league.* registry
+                # absorption (telemetry/plane.py)
+                entry["league"] = sidecar.status()
             # shard-health drive-bys ride the base stats schema (zeros on
             # the in-process path) so r2d2_top renders one line format
             entry["corrupt_blocks"] = s["corrupt_blocks"]
@@ -1148,9 +1234,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
 
     def chaos_loop():
         # process-plane fault sites (fleet kill, slab garbling, replay
-        # shard kill/stall); learner freeze fires from learner_stop,
-        # checkpoint truncation from the Checkpointer itself, sample-
-        # response garbling from the replay plane's receipt path
+        # shard kill/stall, eval-sidecar kill); learner freeze fires from
+        # learner_stop, checkpoint truncation from the Checkpointer
+        # itself, sample-response garbling from the replay plane's
+        # receipt path
         while not stop():
             time.sleep(0.05)
             if plane is not None:
@@ -1159,6 +1246,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             if replay_plane is not None:
                 chaos.maybe_kill_replay_shard(replay_plane)
                 chaos.maybe_stall_shard(replay_plane)
+            if sidecar is not None:
+                chaos.maybe_kill_eval_sidecar(sidecar)
 
     def snapshot_loop():
         # periodic insurance against kill -9 (no drain possible): the
@@ -1189,7 +1278,9 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                                     or chaos.enabled("garble_block")))
             or (replay_plane is not None
                 and (chaos.enabled("kill_replay_shard")
-                     or chaos.enabled("stall_shard")))):
+                     or chaos.enabled("stall_shard")))
+            or (sidecar is not None
+                and chaos.enabled("kill_eval_sidecar"))):
         loops.append(("chaos", chaos_loop))
     if want_full_save and cfg.replay_snapshot_interval > 0:
         loops.append(("snapshot", snapshot_loop))
@@ -1198,6 +1289,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         # plumbing (block ingest, weight pump, process watchdog) runs as
         # supervised fabric threads just like the actor threads would
         loops += plane.make_loops(stop, buffer.add)
+    if sidecar is not None:
+        # the eval sidecar's watchdog (respawn-with-cursor-resume): its
+        # budget exhausting degrades health, never the fabric
+        loops += sidecar.make_loops(stop)
     if replay_plane is not None:
         # sharded replay: the shard-process watchdog (respawn + restore)
         loops += replay_plane.make_loops(stop)
@@ -1258,6 +1353,8 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                 replay_plane.start()
             if plane is not None:
                 plane.start(sys["param_store"])
+            if sidecar is not None:
+                sidecar.start()
             scaffold.start(loops)
             with device_profile(profile_dir):
                 if sys["ring"] is not None:
@@ -1270,6 +1367,15 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                                           stop=learner_stop, tracer=tracer)
         finally:
             scaffold.quiesce()
+            league_final = None
+            if sidecar is not None:
+                # status sampled pre-shutdown so metrics report the
+                # verdict the run actually served with, then stop the
+                # child before the fleet plane: eval is pure overhead
+                # during a drain, and a sidecar mid-restore must not
+                # race the retention GC the epilogue save may trigger
+                league_final = sidecar.status()
+                sidecar.shutdown()
             if plane is not None:
                 # drain-then-save: collect resumable actor snapshots from the
                 # dying fleets (answered by their shutdown handshake)
@@ -1317,6 +1423,18 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             metrics["fleet_health"] = plane.health()
         if replay_plane is not None:
             metrics["replay_shard_health"] = replay_plane.health()
+        if sidecar is not None:
+            # pre-shutdown verdict + a final table re-read (rows the
+            # sidecar committed during its own drain still count)
+            metrics["league"] = dict(sidecar.status(max_age=0.0),
+                                     health=(league_final or {}).get(
+                                         "health",
+                                         sidecar.health()))
+        # member-tagged experience flow ({0: n} outside a population;
+        # the sharded facade reports {} — its per-member counts live
+        # shard-side, the plane's population rows cover the trainer view)
+        metrics["blocks_per_member"] = buffer.stats().get(
+            "blocks_per_member", {})
         return metrics
     finally:
         # AFTER the epilogue: the priority drain and the full-state

@@ -72,6 +72,13 @@ recovery path the fabric claims to have can be exercised under load:
                       never a lockstep window a straggler can hold
                       hostage); the session either resumes or idle-
                       reaps.
+- ``kill_eval_sidecar`` — (league plane, ``cfg.league_eval``) SIGKILL
+                      the standing eval sidecar mid-sweep; the
+                      ``eval_watch`` loop must respawn it with its
+                      checkpoint cursor resumed from league.jsonl (no
+                      duplicate rows, no skipped members), training
+                      throughput untouched; an exhausted respawn budget
+                      degrades /healthz, never the fabric.
 
 Spec grammar — semicolon-separated ``kind[:key=val[,key=val...]]``::
 
@@ -108,7 +115,7 @@ _KINDS = ("kill_fleet", "garble_block", "truncate_ckpt", "freeze_learner",
           "freeze_service", "drop_act_response", "garble_act_response",
           "stall_pump", "wedge_dispatch", "kill_replay_shard",
           "garble_sample_response", "stall_shard", "kill_session_client",
-          "slow_session_client")
+          "slow_session_client", "kill_eval_sidecar")
 
 
 def parse_spec(spec: str) -> Dict[str, Dict[str, float]]:
@@ -305,6 +312,21 @@ class ChaosInjector:
             except (ProcessLookupError, OSError):
                 pass   # died while stopped: the watchdog takes over
         return s
+
+    def maybe_kill_eval_sidecar(self, sidecar: Any) -> bool:
+        """SIGKILL the league eval sidecar subprocess mid-sweep — the
+        cursor-resume drill: the ``eval_watch`` respawn must continue
+        the checkpoint cursor from league.jsonl with no duplicate rows,
+        and training throughput must be unaffected.  Returns True when
+        the kill landed."""
+        if self.fire("kill_eval_sidecar") is None:
+            return False
+        p = getattr(sidecar, "proc", None)
+        if p is None or not p.is_alive():
+            return False
+        log.warning("chaos: SIGKILL eval sidecar (pid %s)", p.pid)
+        p.kill()
+        return True
 
     def session_client_kill(self) -> bool:
         """One opportunity per load-gen client step burst: True = the

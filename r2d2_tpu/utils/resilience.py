@@ -32,9 +32,12 @@ dispatch deadline (``learner/anakin.run_anakin_loop``).
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
 
 # CircuitBreaker states (gauge-friendly integer codes: the slab publishes
 # the state as a float and the registry renders it as a gauge)
@@ -43,6 +46,39 @@ OPEN = 1
 HALF_OPEN = 2
 
 STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
+
+
+def bounded_event_set(event, timeout: float = 2.0, name: str = "") -> bool:
+    """Best-effort ``multiprocessing.Event.set()`` with a hard bound.
+
+    A SIGKILLed subprocess (a chaos ``kill_*`` drill, an OOM kill, a
+    preemption) can die while holding the event's internal condition
+    lock — the documented multiprocessing caveat the fleet plane's
+    channel-retirement design exists for — after which a naked ``set()``
+    on that corrupted primitive blocks its caller FOREVER (observed as a
+    wedged teardown under ``kill_fleet`` chaos: the trainer hung inside
+    ``Event.set`` while every child was already dead).  The set
+    therefore runs on a daemon thread that is abandoned on timeout.
+    Returns False when the lock never came free; callers fall through to
+    their terminate/join path, which reaps the children regardless —
+    a child that never saw the stop flag dies by SIGTERM like any
+    kill -9-grade failure.  Trainer-side *reads* of a child-shared event
+    must not exist at all (mirror the flag in a plain Python bool); this
+    helper only bounds the one write a graceful drain needs to attempt.
+    """
+    t = threading.Thread(  # graftlint: disable=thread-discipline -- the whole point is a thread the caller can ABANDON when a SIGKILL-corrupted event lock never comes free; supervision would add a restart loop around an unbounded wait
+        target=event.set, daemon=True,
+        name=f"event-set-{name}" if name else "event-set")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        log.warning(
+            "event.set()%s did not complete within %.1fs — a killed "
+            "subprocess likely died holding the event's lock; "
+            "abandoning the set and relying on terminate/join to reap "
+            "the children", f" ({name})" if name else "", timeout)
+        return False
+    return True
 
 
 class Deadline:

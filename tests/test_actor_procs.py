@@ -173,15 +173,30 @@ def test_train_process_transport_end_to_end():
     transport's living proof — ~25 s on an idle host; the explicit
     timeout gives contended hosts headroom over the 300 s default, and
     train()'s own max_wall_seconds bounds a genuine wedge well inside
-    it."""
+    it.  The run stops via ``stop_fn`` once 6 updates have landed AND
+    both fleets have contributed blocks — a fixed training_steps used
+    to end the run the moment the learner got there, which on a loaded
+    host could beat the second fleet's slow spawn to its first block
+    and flake the both-fleets assertion."""
+    import threading
+
     from r2d2_tpu.train import train
 
+    done = threading.Event()
+
+    def log_sink(e):
+        fleet = e.get("fleet") or {}
+        if (e.get("training_steps", 0) >= 6
+                and all(c > 0 for c in
+                        fleet.get("blocks_per_fleet") or [0])):
+            done.set()
+
     cfg = make_test_config(game_name="Fake", num_actors=4, actor_fleets=2,
-                           actor_transport="process", training_steps=6,
-                           log_interval=0.2)
+                           actor_transport="process",
+                           training_steps=10 ** 9, log_interval=0.2)
     m = train(cfg, env_factory=make_fake_env, max_wall_seconds=240,
-              verbose=False)
-    assert m["num_updates"] >= cfg.training_steps
+              verbose=False, log_sink=log_sink, stop_fn=done.is_set)
+    assert m["num_updates"] >= 6
     assert np.isfinite(m["mean_loss"])
     assert not m["fabric_failed"]
     assert m["buffer_training_steps"] == m["num_updates"]

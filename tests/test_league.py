@@ -1,0 +1,649 @@
+"""Population training plane + standing evaluation service (r2d2_tpu/
+league, docs/LEAGUE.md): the population_spec grammar and its Config/
+graftlint validation, member-tagged blocks through the shm wire into
+replay stats, the eval sidecar's checkpoint-follow/cursor-resume
+discipline (which pins the ``Learner._save`` skip-complete fix), serve
+follow-mode, and the acceptance e2e — a 2-member population train()
+with the sidecar attached, league table live on /statusz, and a killed
+sidecar degrading /healthz without touching training.
+
+The env factory lives at module level: spawn children unpickle it by
+reference (the process-transport constraint).
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import (
+    POPULATION_MEMBER_FIELDS,
+    POPULATION_META_KEYS,
+    POPULATION_PRESETS,
+    low_resource_config,
+    parse_population,
+)
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs.fake import FakeAtariEnv
+
+A = 4
+
+# 2 members: the base config + the low-resource member preset
+SPEC_2 = json.dumps([
+    {"name": "base"},
+    {"name": "low", "preset": "low_resource"},
+])
+
+
+def make_fake_env(cfg, seed):
+    """Module-level (picklable) factory for the spawn children."""
+    return FakeAtariEnv(obs_shape=cfg.stored_obs_shape, action_dim=A,
+                        seed=seed, episode_len=32)
+
+
+def pop_cfg(**kw):
+    base = dict(game_name="Fake", actor_transport="process",
+                num_actors=4, actor_fleets=2, population_spec=SPEC_2)
+    base.update(kw)
+    return make_test_config(**base)
+
+
+def _poll(predicate, deadline_s, interval=0.1):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ------------------------------------------------------------ spec grammar
+
+def test_parse_population_presets_and_overrides():
+    members = parse_population(SPEC_2)
+    assert [m["name"] for m in members] == ["base", "low"]
+    assert members[0]["overrides"] == {}
+    low = members[1]["overrides"]
+    # preset keys expanded, explicit member keys win over preset keys
+    assert low["gamma"] == 0.99 and low["base_eps"] == 0.3
+    got = parse_population(
+        '[{"preset": "low_resource", "base_eps": 0.2}]')
+    assert got[0]["overrides"]["base_eps"] == 0.2
+    # JSON floats coerce to the field's declared int type
+    got = parse_population('[{"eval_episodes": 2.0}]')
+    assert got[0]["overrides"]["eval_episodes"] == 2
+    assert isinstance(got[0]["overrides"]["eval_episodes"], int)
+
+
+@pytest.mark.parametrize("spec,match", [
+    ("not json", "not valid JSON"),
+    ("{}", "JSON list"),
+    ("[]", "JSON list"),
+    ('[{"preset": "huge"}]', "unknown preset"),
+    ('[{"nstep": 3}]', "not a Config field"),
+    ('[{"hidden_dim": 32}]', "not population-overridable"),
+    ('[{"block_length": 16}]', "not population-overridable"),
+    # per-member n-step is whitelisted OUT: the learner's target gather
+    # bootstraps at the base n (POPULATION_MEMBER_FIELDS rationale)
+    ('[{"forward_steps": 3}]', "not population-overridable"),
+    ('[{"name": "a"}, {"name": "a"}]', "unique"),
+])
+def test_parse_population_rejects(spec, match):
+    with pytest.raises(ValueError, match=match):
+        parse_population(spec)
+
+
+def test_config_population_validation():
+    cfg = pop_cfg()   # valid: 2 members, 2 process fleets
+    assert cfg.actor_fleets == 2
+    with pytest.raises(ValueError, match="actor_transport='process'"):
+        make_test_config(population_spec=SPEC_2, actor_fleets=2, num_actors=4)
+    with pytest.raises(ValueError, match="one fleet per member"):
+        pop_cfg(actor_fleets=1, num_actors=4)
+
+
+def test_lint_vocabulary_pinned_to_config():
+    """The analyzer restates the population vocabulary (it must not
+    execute repo code); this pin is what keeps the two in sync."""
+    from r2d2_tpu.analysis import config_integrity as ci
+
+    assert ci._POPULATION_META_KEYS == set(POPULATION_META_KEYS)
+    assert ci._POPULATION_MEMBER_FIELDS == set(POPULATION_MEMBER_FIELDS)
+    assert ci._POPULATION_PRESETS == set(POPULATION_PRESETS)
+
+
+def test_low_resource_preset_constructs_and_is_registered():
+    cfg = low_resource_config()
+    assert cfg.hidden_dim == 256 and cfg.forward_steps == 3
+    assert cfg.block_length % cfg.learning_steps == 0
+    from r2d2_tpu.cli import _PRESETS
+
+    assert "low_resource" in _PRESETS
+
+
+def test_cli_population_flags():
+    from r2d2_tpu.cli import build_config
+    import argparse
+
+    ns = argparse.Namespace(
+        preset="test", game="Fake", actors=4, actor_transport="process",
+        actor_inference=None, training_steps=None, seed=None,
+        overrides=[("actor_fleets", 2)])
+    cfg = build_config(ns)
+    cfg = cfg.replace(population_spec=SPEC_2, league_eval=True)
+    assert cfg.league_eval and len(parse_population(
+        cfg.population_spec)) == 2
+
+
+# --------------------------------------------------------- member plumbing
+
+def test_build_members_epsilons_and_wire_compat():
+    from r2d2_tpu.league.population import (
+        assert_wire_compatible,
+        build_members,
+        population_epsilons,
+    )
+    from r2d2_tpu.utils.math import epsilon_ladder
+
+    cfg = pop_cfg()
+    members = build_members(cfg)
+    assert [m.name for m in members] == ["base", "low"]
+    assert members[1].cfg.gamma == 0.99
+    # member configs share the base arch / replay geometry / n-step
+    assert members[1].cfg.hidden_dim == cfg.hidden_dim
+    assert members[1].cfg.forward_steps == cfg.forward_steps
+    assert_wire_compatible(cfg, members, A)
+    eps = population_epsilons(cfg, members)
+    # fleet 0 = member 0's own 2-lane ladder; fleet 1 = member 1's
+    assert eps[:2] == [epsilon_ladder(i, 2, 0.4, 7.0) for i in range(2)]
+    assert eps[2:] == [epsilon_ladder(i, 2, 0.3, 5.0) for i in range(2)]
+    # the degenerate single-member population reproduces the global list
+    base = make_test_config(num_actors=4)
+    single = build_members(base)
+    assert len(single) == 1 and single[0].cfg is base
+
+
+def test_block_wire_carries_member_id():
+    """member_id rides the slot next to cut_ts/trace_id — outside the
+    CRC (telemetry, not experience), stamped by the fleet producer."""
+    import multiprocessing as mp
+
+    from r2d2_tpu.parallel.actor_procs import (
+        ShmBlockChannel,
+        ShmBlockProducer,
+    )
+    from tests.test_actor_procs import scripted_blocks
+
+    cfg = make_test_config()
+    ctx = mp.get_context("spawn")
+    channel = ShmBlockChannel(cfg, A, num_slots=2, ctx=ctx)
+    producer = ShmBlockProducer(cfg, A, channel.producer_info(),
+                                ctx.Event(), src=1, member_id=3)
+    try:
+        blk, prios, ep = scripted_blocks(cfg, 1)[0]
+        assert blk.member_id == 0
+        producer.send(blk, prios, ep)
+        got = channel.recv(timeout=10.0)
+        assert got is not None
+        b2, _, _, slot, src = got
+        assert b2.member_id == 3 and src == 1
+        channel.release(slot)
+    finally:
+        producer.close()
+        channel.close()
+
+
+def test_replay_buffer_counts_blocks_per_member():
+    from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+    from tests.test_actor_procs import scripted_blocks
+
+    cfg = make_test_config()
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(0))
+    items = scripted_blocks(cfg, 3, partial_last=False)
+    for i, (blk, prios, ep) in enumerate(items):
+        blk.member_id = i % 2
+        buf.add(blk, prios, ep)
+    s = buf.stats()
+    assert s["blocks_per_member"] == {0: 2, 1: 1}
+
+
+# ------------------------------------------------------------- league math
+
+def test_league_table_aggregation():
+    from r2d2_tpu.league.eval_service import league_table
+
+    rows = [
+        dict(kind="eval", step=2, member=0, member_name="base",
+             game="Fake", mean_reward=1.0),
+        dict(kind="eval", step=2, member=1, member_name="low",
+             game="Fake", mean_reward=5.0),
+        dict(kind="eval", step=4, member=0, member_name="base",
+             game="Fake", mean_reward=3.0),
+        dict(kind="other"),
+    ]
+    t = league_table(rows, num_members=2)
+    assert t["rows"] == 3 and t["last_step"] == 4
+    assert t["sweeps"] == 1            # step 4 lacks member 1
+    # ranked best-first: member 1's 5.0 beats member 0's 3.0
+    assert [r["member"] for r in t["table"]] == [1, 0]
+    m0 = t["table"][1]
+    assert m0["last_step"] == 4 and m0["last_reward"] == 3.0
+    assert m0["best_reward"] == 3.0 and m0["evals"] == 2
+    # a member that never scored holds sweeps at 0
+    assert league_table(rows[:1], num_members=2)["sweeps"] == 0
+
+
+# ----------------------------------------------------- sidecar follow loop
+
+def _save_fake_ckpt(ckpt, cfg, step, seed=0):
+    from r2d2_tpu.checkpoint import arch_meta
+    from r2d2_tpu.models.network import create_network, init_params
+
+    net = create_network(cfg, A)
+    params = jax.device_get(init_params(cfg, net,
+                                        jax.random.PRNGKey(seed)))
+    ckpt.save(step, {"params": params},
+              meta=dict(env_steps=100 * step, minutes=0.1 * step,
+                        **arch_meta(cfg)))
+
+
+def test_sidecar_follows_checkpoints_and_resumes_cursor(tmp_path):
+    """The sidecar core, driven in-process (run_once): every complete
+    checkpoint × member gets exactly one league.jsonl row; a second
+    invocation (= a respawned sidecar) resumes the cursor from the file
+    and re-scores NOTHING; a new checkpoint adds only its own rows."""
+    from r2d2_tpu.checkpoint import Checkpointer
+    from r2d2_tpu.league.eval_service import (
+        _sidecar_main,
+        league_table,
+        read_league,
+    )
+
+    cfg = pop_cfg(league_eval_episodes=2)
+    ckpt = Checkpointer(str(tmp_path))
+    _save_fake_ckpt(ckpt, cfg, 2)
+    _save_fake_ckpt(ckpt, cfg, 4)
+    stop = threading.Event()
+    _sidecar_main(cfg, str(tmp_path), A, stop, run_once=True)
+    rows = read_league(str(tmp_path))
+    assert sorted((r["step"], r["member"]) for r in rows) == [
+        (2, 0), (2, 1), (4, 0), (4, 1)]
+    assert all(r["incarnation"] == 0 for r in rows)
+    # held-out determinism: the same (step, member) eval reproduces
+    by_pair = {(r["step"], r["member"]): r["mean_reward"] for r in rows}
+
+    # "respawn": a fresh invocation resumes the cursor — zero new rows
+    _sidecar_main(cfg, str(tmp_path), A, stop, run_once=True,
+                  incarnation=1)
+    assert len(read_league(str(tmp_path))) == 4
+
+    # a new checkpoint appears: only its own (step, member) rows land
+    _save_fake_ckpt(ckpt, cfg, 6, seed=1)
+    _sidecar_main(cfg, str(tmp_path), A, stop, run_once=True,
+                  incarnation=1)
+    rows = read_league(str(tmp_path))
+    assert len(rows) == 6
+    new = [r for r in rows if r["step"] == 6]
+    assert sorted(r["member"] for r in new) == [0, 1]
+    assert all(r["incarnation"] == 1 for r in new)
+    for r in rows:
+        if (r["step"], r["member"]) in by_pair:
+            assert r["mean_reward"] == by_pair[(r["step"], r["member"])]
+    t = league_table(rows, num_members=2)
+    assert t["sweeps"] == 3 and len(t["table"]) == 2
+
+
+def test_sidecar_skips_arch_incompatible_steps(tmp_path):
+    from r2d2_tpu.checkpoint import Checkpointer
+    from r2d2_tpu.league.eval_service import _sidecar_main, read_league
+
+    cfg = pop_cfg(league_eval_episodes=1)
+    ckpt = Checkpointer(str(tmp_path))
+    _save_fake_ckpt(ckpt, cfg, 2)
+    # step 4 claims a different architecture: must be skipped, not die
+    _save_fake_ckpt(ckpt, cfg.replace(hidden_dim=cfg.hidden_dim * 2), 4)
+    _sidecar_main(cfg, str(tmp_path), A, threading.Event(),
+                  run_once=True)
+    rows = read_league(str(tmp_path))
+    assert sorted({r["step"] for r in rows}) == [2]
+
+
+def test_member_suite_is_held_out_and_includes_jittable_adapter():
+    from r2d2_tpu.league.scenarios import (
+        JittableEnvAdapter,
+        member_suite,
+    )
+
+    cfg = make_test_config(game_name="Fake")
+    envs = member_suite(cfg, member_id=0, episodes=3, action_dim=A)
+    assert len(envs) == 3
+    assert isinstance(envs[-1], JittableEnvAdapter)
+    assert envs[-1].action_space.n == A
+    # the adapter speaks the gym 5-tuple API and truncates like the twin
+    obs, _ = envs[-1].reset()
+    assert obs.shape == cfg.stored_obs_shape and obs.dtype == np.uint8
+    total = 0.0
+    for t in range(40):
+        obs, r, term, trunc, _ = envs[-1].step(0)
+        total += r
+        assert not term
+        if trunc:
+            break
+    assert trunc and t == 31        # episode_len=32 truncation
+    # suites are member-disjoint (different seed planes): the seeded
+    # reset-phase streams must diverge somewhere over 8 resets
+    # (false-fail probability 4^-8 if the planes were identical... which
+    # is the condition being ruled out)
+    e0 = member_suite(cfg, member_id=0, episodes=2, action_dim=A)[0]
+    e1 = member_suite(cfg, member_id=1, episodes=2, action_dim=A)[0]
+    seq0 = [e0.reset()[0].tobytes() for _ in range(8)]
+    seq1 = [e1.reset()[0].tobytes() for _ in range(8)]
+    assert seq0 != seq1, "member suites share a seed plane"
+
+
+# ---------------------------------------------- Learner._save follow pins
+
+def test_learner_save_skip_complete_under_live_follower(tmp_path):
+    """Pins the ``Learner._save`` skip-complete fix the sidecar's follow
+    mode depends on: re-saving an already-complete step would have orbax
+    delete-and-rewrite the payload under a follower that just selected
+    it.  A saver thread saves steps (with the epilogue's duplicate-save
+    collision on every step) while a follower restores each step as it
+    appears — every restore must succeed, and the checkpointer must
+    have written each step exactly once."""
+    from r2d2_tpu.checkpoint import Checkpointer
+    from r2d2_tpu.learner.learner import Learner
+    from r2d2_tpu.learner.step import create_train_state
+    from r2d2_tpu.models.network import create_network, init_params
+
+    cfg = make_test_config()
+    net = create_network(cfg, A)
+    state = create_train_state(
+        cfg, init_params(cfg, net, jax.random.PRNGKey(0)))
+    ckpt = Checkpointer(str(tmp_path))
+    saves = []
+    real_save = ckpt.save
+    ckpt.save = lambda step, st, meta=None: (
+        saves.append(step), real_save(step, st, meta=meta))[-1]
+    learner = Learner(cfg, net, state, checkpointer=ckpt)
+
+    steps = [1, 2, 3, 4, 5]
+    failures = []
+
+    def saver():
+        t0 = time.time()
+        for s in steps:
+            learner._save(s, t0)
+            learner._save(s, t0)   # the epilogue collision: must skip
+            time.sleep(0.02)
+
+    th = threading.Thread(target=saver)
+    th.start()
+    seen = set()
+    deadline = time.time() + 120
+    try:
+        while len(seen) < len(steps) and time.time() < deadline:
+            s = ckpt.latest_step()
+            if s is None or s in seen:
+                time.sleep(0.005)
+                continue
+            try:
+                raw, meta = ckpt.restore(None, step=s)
+                assert raw["params"] is not None
+                assert meta["step"] == s
+            except Exception as e:   # a torn read IS the regression
+                failures.append((s, repr(e)))
+            seen.add(s)
+    finally:
+        th.join(60)
+    assert not failures, failures
+    assert seen == set(steps)
+    # exactly one orbax write per step — the duplicate saves were skipped
+    assert sorted(saves) == steps
+
+
+# ------------------------------------------------------ serve follow-mode
+
+def test_serve_follow_republishes_with_parity_gate(tmp_path):
+    """follow_params_once: a new complete step republishes through the
+    batcher (version bumps), an arch-drifted step is skipped without
+    dying, and the bf16 parity gate actually runs per republish."""
+    from r2d2_tpu.checkpoint import Checkpointer
+    from r2d2_tpu.serving.server import SessionServer, follow_params_once
+
+    cfg = make_test_config(game_name="Fake", serve_port=-1)
+    ckpt = Checkpointer(str(tmp_path))
+    _save_fake_ckpt(ckpt, cfg, 1)
+    server = SessionServer(cfg, A)
+    try:
+        followed = dict(step=0, republishes=0, parity_failures=0)
+        assert follow_params_once(server, ckpt, cfg, followed)
+        assert followed == dict(step=1, republishes=1, parity_failures=0)
+        v1 = server.batcher.version
+        # no new step: no-op
+        assert not follow_params_once(server, ckpt, cfg, followed)
+        assert server.batcher.version == v1
+        # new step: republish, version bumps
+        _save_fake_ckpt(ckpt, cfg, 3, seed=1)
+        assert follow_params_once(server, ckpt, cfg, followed)
+        assert followed["republishes"] == 2
+        assert server.batcher.version == v1 + 1
+        # arch drift: skipped (marked adjudicated), serving stays put
+        _save_fake_ckpt(ckpt, cfg.replace(hidden_dim=cfg.hidden_dim * 2),
+                        5)
+        assert not follow_params_once(server, ckpt, cfg, followed)
+        assert followed["step"] == 5
+        assert server.batcher.version == v1 + 1
+    finally:
+        server.close()
+
+
+def test_bf16_greedy_parity_gate_runs_and_passes(tmp_path):
+    from r2d2_tpu.models.network import create_network, init_params
+    from r2d2_tpu.serving.batcher import ContinuousBatcher
+
+    cfg = make_test_config(serve_dtype="bfloat16", serve_max_batch=8)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    b = ContinuousBatcher(cfg, A)
+    assert b.greedy_parity_ok(jax.device_get(params))
+    # f32 serving: trivially true, no act dispatched
+    b32 = ContinuousBatcher(cfg.replace(serve_dtype="float32"), A)
+    assert b32.greedy_parity_ok(params)
+
+
+# ------------------------------------------------------- acceptance e2e
+
+@pytest.mark.timeout(600)
+def test_league_acceptance_e2e(tmp_path):
+    """The acceptance path: a 2-member population train() (base + the
+    low-resource member preset) with the eval sidecar attached —
+    member-tagged blocks in replay stats, >= 2 complete eval sweeps
+    while training runs, a league table with one row per member on a
+    live /statusz, population.* and league.* series on /metrics, and a
+    clean drain."""
+    from r2d2_tpu.train import train
+
+    cfg = pop_cfg(league_eval=True, league_eval_episodes=2,
+                  league_eval_interval=0.2, training_steps=10 ** 9,
+                  save_interval=3, log_interval=0.3, telemetry_port=-1,
+                  learning_starts=16)
+    done = threading.Event()
+    port = {}
+
+    def log_sink(e):
+        if e.get("telemetry_port"):
+            port["p"] = e["telemetry_port"]
+
+    result = {}
+
+    def run():
+        result["m"] = train(cfg, env_factory=make_fake_env,
+                            checkpoint_dir=str(tmp_path),
+                            max_wall_seconds=420, verbose=False,
+                            log_sink=log_sink, stop_fn=done.is_set)
+
+    th = threading.Thread(target=run)
+    th.start()
+    live_league = {}
+    try:
+        assert _poll(lambda: "p" in port, 240), "no telemetry port"
+
+        def two_sweeps_on_statusz():
+            # polled over the LIVE endpoint — the league table must be
+            # present on /statusz while training runs, not just in the
+            # post-run metrics
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port['p']}/statusz",
+                        timeout=10) as r:
+                    status = json.loads(r.read())
+            except OSError:
+                return False
+            lg = (status.get("last_entry") or {}).get("league") or {}
+            if lg:
+                live_league.update(lg)
+            return lg.get("sweeps", 0) >= 2
+
+        assert _poll(two_sweeps_on_statusz, 300, interval=0.3), \
+            "never reached 2 eval sweeps on a live /statusz"
+        assert live_league.get("members") == 2
+        assert len(live_league.get("table") or []) == 2
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port['p']}/metrics", timeout=10) as r:
+            metrics_text = r.read().decode()
+    finally:
+        done.set()
+        th.join(300)
+    assert not th.is_alive(), "train() never drained"
+    m = result["m"]
+    assert not m["fabric_failed"]
+    assert m["num_updates"] > 0
+    # member-tagged blocks observed in replay stats: BOTH members flowed
+    bpm = m["blocks_per_member"]
+    assert set(bpm) == {0, 1} and all(v > 0 for v in bpm.values())
+    # >= 2 complete sweeps while training ran, one table row per member
+    league = m["league"]
+    assert league["sweeps"] >= 2
+    assert [r["member"] for r in sorted(league["table"],
+                                        key=lambda r: r["member"])] \
+        == [0, 1]
+    assert league["health"]["failed"] is False
+    # per-member population rows rode the stats slab into fleet health
+    pop = m["fleet_health"]["population"]["members"]
+    assert [r["member"] for r in pop] == [0, 1]
+    assert all(r["env_steps"] > 0 and r["blocks"] > 0 for r in pop)
+    assert pop[1]["name"] == "low" and pop[1]["preset"] == "low_resource"
+    # the scrape surface carries both namespaces
+    assert 'r2d2_population_env_steps_total{member="1"}' in metrics_text
+    assert "r2d2_league_sweeps_total" in metrics_text
+
+
+@pytest.mark.timeout(600)
+def test_chaos_kill_eval_sidecar_degrades_health_not_training(tmp_path):
+    """kill_eval_sidecar chaos with the respawn budget exhausted: the
+    sidecar dies for good, /healthz flips to `degraded` (HTTP 200 — the
+    scoreboard died, not the run), and training keeps going to a clean
+    drain."""
+    from r2d2_tpu.train import train
+
+    # every=1 on the 0.05 s chaos poll: the sidecar is killed the moment
+    # it spawns, over and over, until the watch budget exhausts
+    cfg = pop_cfg(league_eval=True, league_eval_interval=0.2,
+                  training_steps=10 ** 9, save_interval=5,
+                  log_interval=0.3, telemetry_port=-1, learning_starts=16,
+                  chaos_spec="kill_eval_sidecar:every=1,n=1000000")
+    degraded = threading.Event()
+    trained = threading.Event()
+    port = {}
+
+    def log_sink(e):
+        if e.get("telemetry_port"):
+            port["p"] = e["telemetry_port"]
+        if ((e.get("league") or {}).get("health") or {}).get("failed"):
+            degraded.set()
+        if e.get("training_steps", 0) > 0:
+            trained.set()
+
+    stop = threading.Event()
+    result = {}
+
+    def run():
+        result["m"] = train(cfg, env_factory=make_fake_env,
+                            checkpoint_dir=str(tmp_path),
+                            max_wall_seconds=420, verbose=False,
+                            log_sink=log_sink, stop_fn=stop.is_set)
+
+    th = threading.Thread(target=run)
+    th.start()
+    try:
+        assert _poll(degraded.is_set, 300), \
+            "sidecar never exhausted its respawn budget"
+        assert _poll(lambda: "p" in port, 60)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port['p']}/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+            code = r.status
+        # degraded, HTTP 200: training is fine, only the evaluator died
+        assert code == 200
+        assert health["status"] == "degraded"
+        assert health["league"]["failed"] is True
+        # training keeps going AFTER the sidecar is dead for good
+        assert _poll(trained.is_set, 300), \
+            "no learner update after the sidecar failed"
+    finally:
+        stop.set()
+        th.join(300)
+    assert not th.is_alive()
+    m = result["m"]
+    assert not m["fabric_failed"]
+    assert m["chaos"]["kill_eval_sidecar"] >= 1
+    assert m["league"]["health"]["failed"] is True
+    # training was untouched: updates advanced, blocks kept flowing
+    assert m["num_updates"] > 0
+    assert all(v > 0 for v in m["blocks_per_member"].values())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_league_jsonl_continuous_across_resume(tmp_path):
+    """SIGTERM→resume continuity at train() level: two runs sharing one
+    checkpoint dir yield ONE league.jsonl whose rows are append-only
+    across the restart — run 1's rows survive verbatim, run 2 adds only
+    new (step, member) pairs.  slow: two full process-transport
+    bring-ups."""
+    from r2d2_tpu.league.eval_service import read_league
+    from r2d2_tpu.train import train
+
+    cfg = pop_cfg(league_eval=True, league_eval_episodes=2,
+                  league_eval_interval=0.2, training_steps=10 ** 9,
+                  save_interval=3, log_interval=0.3, learning_starts=16)
+
+    def run_until(prior_rows, min_new):
+        done = threading.Event()
+
+        def log_sink(e):
+            if (e.get("league") or {}).get("rows", 0) >= (
+                    prior_rows + min_new):
+                done.set()
+
+        return train(cfg, env_factory=make_fake_env,
+                     checkpoint_dir=str(tmp_path), resume=prior_rows > 0,
+                     max_wall_seconds=300, verbose=False,
+                     log_sink=log_sink, stop_fn=done.is_set)
+
+    m1 = run_until(0, 2)
+    assert not m1["fabric_failed"]
+    rows1 = read_league(str(tmp_path))
+    assert len(rows1) >= 2
+    m2 = run_until(len(rows1), 2)
+    assert not m2["fabric_failed"]
+    rows2 = read_league(str(tmp_path))
+    # one continuous record: run 1's rows are a verbatim prefix
+    assert rows2[:len(rows1)] == rows1
+    assert len(rows2) > len(rows1)
+    pairs = [(r["step"], r["member"]) for r in rows2]
+    assert len(pairs) == len(set(pairs)), "duplicate league rows"

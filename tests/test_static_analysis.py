@@ -55,6 +55,9 @@ def test_repo_tree_is_clean():
         # abandoned on a hard wedge by design, nothing to supervise
         ("r2d2_tpu/learner/anakin.py", "thread-discipline"),
         ("r2d2_tpu/parallel/actor_procs.py", "thread-discipline"),
+        # bounded_event_set: an abandon-on-timeout thread IS the point —
+        # a SIGKILL-corrupted mp.Event lock must never wedge a teardown
+        ("r2d2_tpu/utils/resilience.py", "thread-discipline"),
         # nullable-tracer pass-through helper; call sites pass literals
         ("r2d2_tpu/parallel/inference_service.py", "telemetry-discipline"),
         # lineage flow-point pass-through helper; call sites pass literals
@@ -253,6 +256,40 @@ def test_config_integrity_suppressed():
             return cfg.retired_knob  # graftlint: disable=config-integrity -- fixture
     """), config_schema=_SCHEMA, rules=["config-integrity"])
     assert report.findings == [] and len(report.suppressed) == 1
+
+
+def test_config_integrity_flags_bad_population_spec():
+    """Inline population_spec literals validate against the Config
+    schema: misspelled member knobs, non-overridable fields, unknown
+    presets and malformed JSON are findings, never silent no-ops
+    (docs/LEAGUE.md; the runtime twin is config.parse_population)."""
+    report = analyze_source(_src("""
+        cfg = make(population_spec='[{"name": "a", "gama": 0.9}]')
+        population_spec = '[{"preset": "giant"}]'
+        c2 = make(population_spec='not json')
+        c3 = make(population_spec='[{"lr": 1e-3}]')
+    """), config_schema=ConfigSchema(
+        fields=["lr", "gamma", "game_name"], properties=[], methods=[]),
+        rules=["config-integrity"])
+    msgs = " | ".join(f.message for f in report.findings)
+    assert len(report.findings) == 4
+    assert "'gama' does not resolve" in msgs
+    assert "unknown preset 'giant'" in msgs
+    assert "not valid JSON" in msgs
+    assert "'lr' is not population-overridable" in msgs
+
+
+def test_config_integrity_negative_valid_population_spec():
+    report = analyze_source(_src("""
+        cfg = make(population_spec='[{"name": "a"}, '
+                   '{"preset": "low_resource", "gamma": 0.99, '
+                   '"game_name": "Pong"}]')
+        off = make(population_spec="")
+        indirect = make(population_spec=SPEC_VAR)  # runtime territory
+    """), config_schema=ConfigSchema(
+        fields=["lr", "gamma", "game_name"], properties=[], methods=[]),
+        rules=["config-integrity"])
+    assert report.findings == []
 
 
 def test_config_integrity_schema_fallback_for_targeted_runs(tmp_path):

@@ -12,7 +12,7 @@ and training keeps advancing.  Exit code 1 on any violated invariant.
 
 Run:  python tools/chaos_soak.py [minutes] [--process] [--serve]
                                  [--anakin] [--shards] [--trace]
-                                 [--sessions] [--out OUT.json]
+                                 [--sessions] [--league] [--out OUT.json]
 
 ``--process`` soaks the subprocess actor plane (enables the kill_fleet /
 garble_block sites); ``--serve`` additionally routes acting through the
@@ -43,7 +43,15 @@ keep the accounting invariant ``admitted == completed + reaped +
 evicted + live``, and keep completing sessions while a straggler is
 frozen; every other round restarts the server through the session
 snapshot (save → restore) and the counters must carry over.
-``--trace`` (implies
+``--league`` soaks the
+POPULATION + standing-eval plane (docs/LEAGUE.md): a 2-member
+population (base + the low_resource member preset) with the eval
+sidecar attached and ``kill_eval_sidecar`` armed — every kill must be
+answered by an eval_watch respawn whose checkpoint cursor resumes from
+league.jsonl (zero duplicate (step, member) rows across the WHOLE
+soak, rows monotone across resume rounds — one continuous record), and
+training throughput must be untouched (the fabric never fails over a
+dead evaluator).  ``--trace`` (implies
 --process) adds a tracing round: once the first round has seen a
 kill_fleet fire, a cross-process capture window is armed mid-soak over
 /tracez, and the round fails unless the dump parses as Chrome trace
@@ -66,7 +74,8 @@ ANAKIN = "--anakin" in _argv
 SHARDS = "--shards" in _argv
 TRACE = "--trace" in _argv
 SESSIONS = "--sessions" in _argv
-PROCESS = "--process" in _argv or SERVE or TRACE
+LEAGUE = "--league" in _argv
+PROCESS = "--process" in _argv or SERVE or TRACE or LEAGUE
 OUT = None
 if "--out" in _argv:
     i = _argv.index("--out")
@@ -309,6 +318,20 @@ def main() -> int:
                          actor_inference="serve" if SERVE else "local")
         # the param-staleness watchdog drill rides along either way
         chaos += ";stall_pump:every=300,dur=2,n=1000000"
+        if LEAGUE:
+            # population + standing-eval soak: 2 members (base + the
+            # low_resource member preset), the eval sidecar attached,
+            # and a sidecar SIGKILL every ~30 s of chaos-loop polls —
+            # each must respawn with the league.jsonl cursor resumed
+            # (zero duplicate rows across the whole soak)
+            transport["actor_fleets"] = 2
+            chaos += ";kill_eval_sidecar:every=600,n=1000000"
+            extra = dict(
+                extra,
+                population_spec='[{"name": "base"}, {"name": "low", '
+                                '"preset": "low_resource"}]',
+                league_eval=True, league_eval_episodes=2,
+                league_eval_interval=0.5)
         if SERVE:
             # one full freeze→degrade→re-attach cycle per round, plus
             # response loss/corruption noise absorbed by bounded retry
@@ -317,7 +340,11 @@ def main() -> int:
             chaos += (";freeze_service:every=800,dur=4,n=1000000"
                       ";drop_act_response:p=0.002"
                       ";garble_act_response:p=0.002")
-            extra = dict(act_response_timeout=0.5)
+            # MERGE, never reassign: --league's extras (population spec,
+            # sidecar knobs) may already be armed — a wholesale
+            # replacement would silently turn --serve --league into a
+            # league-free soak whose league invariants pass vacuously
+            extra = dict(extra, act_response_timeout=0.5)
     if TRACE:
         # the /tracez arming below needs the exporter; kill_fleet rides
         # along from the --process spec so a respawned incarnation
@@ -404,6 +431,25 @@ def main() -> int:
                                           ck.steps(complete=False)
                                           if s not in ck.steps()],
                            replay_steps=ck.replay_steps())
+                if LEAGUE:
+                    # league invariants per round: every committed row
+                    # unique per (step, member) — a respawned sidecar
+                    # resuming its cursor must never double-score; the
+                    # file is append-only so this also covers resume
+                    # continuity across rounds
+                    from r2d2_tpu.league.eval_service import read_league
+
+                    lrows = [e for e in read_league(ck_dir)
+                             if e.get("kind") == "eval"]
+                    pairs = [(e["step"], e["member"]) for e in lrows]
+                    dups = len(pairs) - len(set(pairs))
+                    rec["league"] = m.get("league")
+                    rec["league_rows"] = len(pairs)
+                    rec["league_dups"] = dups
+                    if dups:
+                        failures.append(
+                            f"round {rnd}: {dups} duplicate league "
+                            "rows (cursor resume broke)")
                 rounds.append(rec)
                 print(json.dumps(rec), flush=True)
 
@@ -489,6 +535,24 @@ def main() -> int:
         if not garbles:
             failures.append("garble_sample_response armed but no garbled "
                             "response was ever caught")
+    # soak-level invariants (--league): every sidecar kill must have been
+    # answered by an eval_watch respawn somewhere in the soak (a kill
+    # landing in a round's final seconds may respawn next round), rows
+    # must be monotone across resume rounds (append-on-resume — one
+    # continuous record), and the fabric must never have failed over a
+    # dead evaluator (the per-round resume/update checks cover that)
+    if LEAGUE and rounds:
+        kills = sum((r["chaos"] or {}).get("kill_eval_sidecar", 0)
+                    for r in rounds)
+        respawns = sum((((r.get("league") or {}).get("health") or {})
+                        .get("restarts", 0)) for r in rounds)
+        if kills and not respawns:
+            failures.append(f"{kills} sidecar kills but no eval_watch "
+                            "respawn ever fired")
+        rows_seq = [r.get("league_rows", 0) for r in rounds]
+        if any(b < a for a, b in zip(rows_seq, rows_seq[1:])):
+            failures.append("league rows regressed across resume "
+                            f"rounds: {rows_seq}")
     summary = dict(minutes=MINUTES, rounds=len(rounds), failures=failures,
                    final_updates=last_updates,
                    telemetry_jsonl=runlog.path,

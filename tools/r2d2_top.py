@@ -76,6 +76,34 @@ def render(entry, health=None) -> str:
                 f"retries={int(res.get('retries', 0))} "
                 f"local_acts={int(res.get('local_acts', 0))} "
                 f"stale_params_s={res.get('max_stale_params_s', 0.0)}")
+    pop = (fleet or {}).get("population")
+    if pop:
+        for row in pop.get("members", []):
+            lines.append(
+                f"  member {row.get('member')} {row.get('name', '')} "
+                f"[{row.get('game', '')}] lanes={row.get('lanes', 0)} "
+                f"env_steps={row.get('env_steps', 0)} "
+                f"blocks={row.get('blocks', 0)} "
+                f"episodes={row.get('episodes', 0)}")
+    league = entry.get("league")
+    if league:
+        h = league.get("health") or {}
+        verdict = ("  ** SIDECAR FAILED **" if h.get("failed")
+                   else "" if h.get("alive", True) else "  (respawning)")
+        lines.append(
+            f"  league: rows={league.get('rows', 0)} "
+            f"sweeps={league.get('sweeps', 0)} "
+            f"last_step={league.get('last_step', -1)}" + verdict)
+        for row in league.get("table") or []:
+            best = row.get("best_reward")
+            lines.append(
+                f"    #{row.get('member')} {row.get('name', '')} "
+                f"[{row.get('game', '')}] "
+                f"last={row.get('last_reward', 0.0):.1f}"
+                f"@{row.get('last_step', -1)} "
+                + (f"best={best:.1f}@{row.get('best_step', -1)} "
+                   if best is not None else "")
+                + f"evals={row.get('evals', 0)}")
     chaos = entry.get("chaos")
     if chaos:
         lines.append("  chaos: " + " ".join(f"{k}={v}"
