@@ -11,7 +11,8 @@ was specifically designed to keep allocation-light.
 
 The check: every call of a metric-writing method — ``inc``,
 ``counter_max``, ``set_gauge``, ``observe``, ``observe_many``,
-``declare_histogram`` on a registry-shaped receiver, plus the Tracer
+``declare_histogram``, ``absorb_histogram`` on a registry-shaped
+receiver, plus the Tracer
 surface (``span``, ``gauge``, ``incr``) and the cross-process event
 tracer's recording surface (``instant``, ``complete`` —
 telemetry/tracing.py; variable parts go in ``flow``/``arg``, never the
@@ -24,6 +25,18 @@ family as config-integrity's receivers; bulk absorption helpers
 exempt by design — they exist to fold fixed upstream surfaces, carry
 their own suppression where they synthesize names, and keep hot loops
 out of it.
+
+**Alert-rule vocabulary** (telemetry/learnhealth.py): alert rule names
+are identities too — an ``alerts.jsonl`` row, a
+``learnhealth.alert{rule=...}`` series, and an operator runbook entry
+all key on them.  Two extra checks:
+
+- an ``AlertRule(...)`` construction (and ``.fire(...)`` on an
+  engine-shaped receiver: ``engine`` / ``alerts`` / ``*_engine`` /
+  ``*alert_engine``) must pass the rule name as a string literal;
+- an ``AlertRule`` ``threshold=`` keyword must not be a bare numeric
+  constant — alert thresholds are operator knobs and belong in cfg
+  (``cfg.alert_*``), never inline magic numbers in rule bodies.
 """
 from __future__ import annotations
 
@@ -36,11 +49,16 @@ RULE = "telemetry-discipline"
 
 # metric-writing methods whose first argument IS a metric/event name
 _METRIC_METHODS = ("inc", "counter_max", "set_gauge", "observe",
-                   "observe_many", "declare_histogram", "span", "gauge",
+                   "observe_many", "declare_histogram",
+                   "absorb_histogram", "span", "gauge",
                    "incr", "instant", "complete")
 
 _RECEIVER_NAMES = ("registry", "metrics", "telemetry", "tracer", "reg",
                    "tr", "events")
+
+# alert-engine vocabulary (telemetry/learnhealth.py)
+_ALERT_RECEIVER_NAMES = ("engine", "alerts")
+_ALERT_THRESHOLD_KWARGS = ("threshold",)
 
 
 def _is_metric_receiver(node: ast.AST) -> bool:
@@ -64,21 +82,77 @@ def _name_arg(call: ast.Call):
     return None
 
 
+def _is_alert_engine_receiver(node: ast.AST) -> bool:
+    """A name that plausibly holds an AlertEngine."""
+    if isinstance(node, ast.Name):
+        n = node.id.lower()
+    elif isinstance(node, ast.Attribute):
+        n = node.attr.lower()
+    else:
+        return False
+    return n in _ALERT_RECEIVER_NAMES or n.endswith(
+        ("_engine", "alert_engine", "_alerts"))
+
+
+def _is_alert_rule_ctor(call: ast.Call) -> bool:
+    f = call.func
+    return ((isinstance(f, ast.Name) and f.id == "AlertRule")
+            or (isinstance(f, ast.Attribute) and f.attr == "AlertRule"))
+
+
+def _is_literal_str(node) -> bool:
+    return isinstance(node, ast.Constant) and isinstance(node.value, str)
+
+
 @rule(RULE, "metric names passed to the registry/tracer must be string "
             "literals (labels carry the variable part)")
 def check_telemetry_discipline(ctx: Context) -> List[Finding]:
     findings: List[Finding] = []
     for mod in ctx.modules:
         for node in ast.walk(mod.tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
+            if not isinstance(node, ast.Call):
+                continue
+            # --- alert-rule vocabulary (telemetry/learnhealth.py) ----
+            if _is_alert_rule_ctor(node):
+                arg = _name_arg(node)
+                if arg is not None and not _is_literal_str(arg):
+                    findings.append(Finding(
+                        RULE, mod.rel, node.lineno,
+                        "AlertRule name is not a string literal — rule "
+                        "names key alerts.jsonl rows and the "
+                        "learnhealth.alert{rule} series "
+                        "(telemetry/learnhealth.py)"))
+                for kw in node.keywords:
+                    if (kw.arg in _ALERT_THRESHOLD_KWARGS
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, (int, float))
+                            and not isinstance(kw.value.value, bool)):
+                        findings.append(Finding(
+                            RULE, mod.rel, node.lineno,
+                            "AlertRule threshold is an inline magic "
+                            "number — alert thresholds are operator "
+                            "knobs and must come from cfg "
+                            "(cfg.alert_*)"))
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "fire"
+                    and _is_alert_engine_receiver(node.func.value)):
+                arg = _name_arg(node)
+                if arg is not None and not _is_literal_str(arg):
+                    findings.append(Finding(
+                        RULE, mod.rel, node.lineno,
+                        "alert rule name for .fire() is not a string "
+                        "literal (telemetry/learnhealth.py)"))
+                continue
+            # --- metric/event name literals --------------------------
+            if not (isinstance(node.func, ast.Attribute)
                     and node.func.attr in _METRIC_METHODS
                     and _is_metric_receiver(node.func.value)):
                 continue
             arg = _name_arg(node)
             if arg is None:
                 continue      # pathological call; runtime will complain
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if _is_literal_str(arg):
                 continue
             kind = type(arg).__name__
             detail = ("f-string" if isinstance(arg, ast.JoinedStr)

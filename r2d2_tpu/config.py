@@ -646,6 +646,49 @@ class Config:
                                       # /tracez endpoint instead
                                       # (--trace-steps / docs/
                                       # OBSERVABILITY.md)
+    # --- learning health (telemetry/learnhealth.py, docs/OBSERVABILITY.md)
+    learnhealth_interval: int = 0     # >0: every N optimizer steps the
+                                      # jitted train step computes the
+                                      # in-graph diagnostic bundle
+                                      # (lax.cond-gated: the paper's ΔQ
+                                      # stored-vs-recomputed-state
+                                      # divergence via a zero-state
+                                      # re-unroll, |TD|/IS-weight
+                                      # histograms, grad/update/param
+                                      # norms, target lag, max|Q|, the
+                                      # NaN/Inf sentry) riding the
+                                      # existing per-dispatch D2H fetch.
+                                      # 0 (default) compiles the step
+                                      # without the bundle — bit-
+                                      # identical to the pre-learnhealth
+                                      # program
+    alert_loss_spike_factor: float = 10.0  # loss_spike alert rule: a
+                                      # harvested loss above this factor
+                                      # times the loss EWMA fires
+                                      # learnhealth.alert{rule=
+                                      # "loss_spike"} (always armed;
+                                      # must be > 1)
+    alert_dq_budget: float = 0.0      # >0: dq_drift alert rule — the
+                                      # armed diag's mean ΔQ above this
+                                      # budget fires (edge-triggered);
+                                      # 0 disables (no universal ΔQ
+                                      # scale exists — set it from a
+                                      # healthy run's learnhealth.dq_mean)
+    alert_ess_min: float = 0.0        # >0: ess_collapse alert rule —
+                                      # any ring/shard whose PER
+                                      # effective-sample-size fraction
+                                      # drops below this (with at least
+                                      # batch_size positive leaves)
+                                      # fires; 0 disables
+    alert_replay_ratio_min: float = 0.0  # replay_ratio alert band lower
+                                      # edge (meaningful only when
+                                      # alert_replay_ratio_max > 0)
+    alert_replay_ratio_max: float = 0.0  # >0: replay_ratio alert rule —
+                                      # the cumulative samples-per-
+                                      # insert ratio leaving
+                                      # [alert_replay_ratio_min, max]
+                                      # fires (edge-triggered); 0
+                                      # disables the band
     anakin_env_steps_per_update: int = 4  # anakin transport: fused
                                       # env/actor steps per optimizer step
                                       # inside the super-step (the
@@ -848,6 +891,28 @@ class Config:
         if self.trace_steps < 0:
             raise ValueError("trace_steps must be >= 0 (0 = no boot-time "
                              "capture; /tracez arms one on demand)")
+        if self.learnhealth_interval < 0:
+            raise ValueError(
+                "learnhealth_interval must be >= 0 (0 disables the "
+                "in-graph diagnostics)")
+        if self.alert_loss_spike_factor <= 1.0:
+            raise ValueError(
+                "alert_loss_spike_factor must be > 1 (a factor <= 1 "
+                "would fire on every ordinary loss fluctuation)")
+        if self.alert_dq_budget < 0:
+            raise ValueError("alert_dq_budget must be >= 0 (0 disables)")
+        if not (0.0 <= self.alert_ess_min < 1.0):
+            raise ValueError(
+                "alert_ess_min must be in [0, 1) — it is a fraction of "
+                "the positive leaf count (0 disables)")
+        if self.alert_replay_ratio_min < 0 or self.alert_replay_ratio_max < 0:
+            raise ValueError("replay-ratio alert band edges must be >= 0")
+        if (self.alert_replay_ratio_max > 0
+                and self.alert_replay_ratio_min
+                > self.alert_replay_ratio_max):
+            raise ValueError(
+                "alert_replay_ratio_min must not exceed "
+                "alert_replay_ratio_max")
         if self.league_eval_episodes < 1:
             raise ValueError("league_eval_episodes must be >= 1")
         if self.league_eval_interval <= 0:

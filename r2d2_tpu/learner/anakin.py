@@ -485,12 +485,16 @@ def make_anakin_super_step(cfg: Config, net: R2D2Network,
 
     All six state arguments are donated; ``flat`` is the per-inner-step
     losses followed by the :data:`STATS_FIELDS` deltas — the dispatch's
-    ONLY device→host payload.  The sampling stream is
-    ``fold_in(PRNGKey(cfg.seed), dispatch_idx)``, matching the
+    ONLY device→host payload.  With ``cfg.learnhealth_interval > 0`` the
+    per-inner-step learnhealth diagnostic rows (telemetry/learnhealth.py;
+    zeros off-cadence) are appended to the SAME flat vector, so the
+    host-crossing count per dispatch is unchanged.  The sampling stream
+    is ``fold_in(PRNGKey(cfg.seed), dispatch_idx)``, matching the
     ``in_graph_per`` drivetrain's scheme (learner/step.py).
     """
     k, E = cfg.superstep_k, cfg.anakin_env_steps_per_update
-    step = make_train_step(cfg, net)
+    lh = getattr(cfg, "learnhealth_interval", 0) > 0
+    step = make_train_step(cfg, net, learnhealth=lh)
     actor_step = _make_actor_step(cfg, net, env, action_dim,
                                   cut_cond=cut_cond)
 
@@ -514,15 +518,24 @@ def make_anakin_super_step(cfg: Config, net: R2D2Network,
             idx, w, ints = _in_graph_sample(cfg, key_t, prios, seq_meta,
                                             first)
             batch = gather_batch(cfg, arrays, ints, w)
-            ts, loss, new_p = step(ts, batch)
+            if lh:
+                ts, loss, new_p, diag = step(ts, batch)
+            else:
+                ts, loss, new_p = step(ts, batch)
             # same feedback exponentiation as the in_graph_per super-step
             prios = prios.at[idx].set(new_p ** cfg.prio_exponent)
-            return (ts, ast, arrays, prios, seq_meta, first), loss
+            return ((ts, ast, arrays, prios, seq_meta, first),
+                    ((loss, diag) if lh else loss))
 
-        (train_state, ast, arrays, prios, seq_meta, first), losses = (
+        (train_state, ast, arrays, prios, seq_meta, first), ys = (
             jax.lax.scan(update, (train_state, ast, arrays, prios,
                                   seq_meta, first), keys))
-        flat = jnp.concatenate([losses, _stats_vec(ast)])
+        if lh:
+            losses, diags = ys
+            flat = jnp.concatenate([losses, _stats_vec(ast),
+                                    diags.reshape(-1)])
+        else:
+            flat = jnp.concatenate([ys, _stats_vec(ast)])
         return train_state, ast, arrays, prios, seq_meta, first, flat
 
     return jax.jit(RETRACES.wrap("learner.anakin_super_step", super_step),
@@ -614,6 +627,11 @@ class AnakinPlane:
         self.cfg = cfg
         self.ring = ring
         self.action_dim = action_dim
+        # learnhealth plane: with a nonzero cadence the fused program's
+        # flat result vector carries the per-inner-step diagnostic rows;
+        # train._train_anakin attaches the run's LearnHealthMonitor
+        self._lh = getattr(cfg, "learnhealth_interval", 0) > 0
+        self.monitor = None
         self.env = AnakinFakeEnv(
             obs_shape=cfg.stored_obs_shape, action_dim=action_dim,
             episode_len=cfg.anakin_episode_len, num_lanes=cfg.num_actors)
@@ -704,9 +722,19 @@ class AnakinPlane:
         v = np.asarray(jax.device_get(flat))
         k = self.cfg.superstep_k
         losses = v[:k]
-        assert np.isfinite(losses).all(), (
-            f"non-finite loss in anakin super-step: {losses}")
-        self._absorb(v[k:])
+        stats = v[k:k + len(STATS_FIELDS)]
+        if self.monitor is not None:
+            # the monitor owns non-finite handling (trips a clean fabric
+            # stop + the nonfinite alert) and absorbs the diag rows the
+            # fused program appended to the same flat vector
+            self.monitor.note_losses(losses)
+            if self._lh:
+                self.monitor.absorb_diags(
+                    v[k + len(STATS_FIELDS):].reshape(k, -1))
+        else:
+            assert np.isfinite(losses).all(), (
+                f"non-finite loss in anakin super-step: {losses}")
+        self._absorb(stats)
         with self._stats_lock:
             self.training_steps += k
             self._interval_loss += float(losses.sum())

@@ -77,6 +77,12 @@ class Learner:
         self._replicate_params = None  # lazily-built multihost resharder
         self._copy_params = None       # lazily-built one-dispatch snapshotter
         self._saved_steps: set = set()  # steps THIS run saved (see _save)
+        # learnhealth plane (telemetry/learnhealth.py): with a nonzero
+        # cadence every drivetrain's compiled step carries the in-graph
+        # diagnostic vector, folded into the existing result fetch; the
+        # trainer attaches a LearnHealthMonitor to absorb it
+        self._lh = getattr(cfg, "learnhealth_interval", 0) > 0
+        self.monitor: Optional[Any] = None
 
         # ONE train-step entry point for every topology: the table-driven
         # pjit step (parallel/sharding.py).  A 1-device trivial mesh makes
@@ -133,6 +139,35 @@ class Learner:
     @property
     def num_updates(self) -> int:
         return int(jax.device_get(self.state.step))
+
+    def _note_results(self, losses_np: np.ndarray,
+                      diags_np: Optional[np.ndarray] = None,
+                      strict: bool = True) -> None:
+        """Route harvested losses (+ learnhealth diagnostics) to the
+        attached monitor.  Without a monitor, ``strict`` preserves the
+        historical fail-fast on a non-finite loss; with one, the monitor
+        trips the fabric's clean stop and fires the ``nonfinite`` alert
+        instead of crashing the learner thread mid-donation."""
+        m = self.monitor
+        if m is not None:
+            m.note_losses(losses_np)
+            if diags_np is not None and diags_np.size:
+                m.absorb_diags(diags_np)
+            return
+        if strict:
+            assert np.isfinite(losses_np).all(), (
+                f"non-finite loss in super-step: {losses_np}")
+
+    def poison_params(self) -> None:
+        """Chaos drill hook (``poison_params`` site, utils/chaos.py):
+        overwrite the first param leaf with NaN so the next step's loss
+        and grads go non-finite — the learnhealth NaN-sentry e2e.  Must
+        run on the learner thread (the state handle is donated per
+        dispatch)."""
+        leaves, treedef = jax.tree.flatten(self.state.params)
+        leaves[0] = leaves[0] * jnp.nan  # multiply keeps the sharding
+        self.state = self.state.replace(
+            params=jax.tree.unflatten(treedef, leaves))
 
     def _stage(self, batch: Dict[str, np.ndarray]
                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
@@ -265,7 +300,12 @@ class Learner:
             of paying a fresh interconnect round trip."""
             host, loss, priorities = pending_item
             with tracer.span("learner.result_sync"):
-                loss = float(jax.device_get(loss))
+                if self._lh:
+                    # the learnhealth diag rides the same flat fetch
+                    flat = np.asarray(jax.device_get(loss))
+                    loss, diag = float(flat[0]), flat[1:]
+                else:
+                    loss, diag = float(jax.device_get(loss)), None
                 # loss is replicated (addressable everywhere); priorities
                 # are dp-sharded, so under a mesh read back only this
                 # host's rows — they pair with the idxes this host sampled
@@ -275,6 +315,7 @@ class Learner:
                     priorities = local_rows(priorities)
                 else:
                     priorities = np.asarray(jax.device_get(priorities))
+            self._note_results(np.asarray([loss]), diag, strict=False)
             losses.append(loss)
             self.env_steps = int(host.get("env_steps", self.env_steps))
             if priority_sink is not None:
@@ -307,8 +348,17 @@ class Learner:
                     break
                 dev_batch, host = item
                 with tracer.span("learner.step_dispatch"):
-                    self.state, loss, priorities = self._step_fn(self.state,
-                                                                 dev_batch)
+                    if self._lh:
+                        (self.state, loss, priorities,
+                         diag) = self._step_fn(self.state, dev_batch)
+                        # fold loss + diag into ONE flat replicated
+                        # vector so the harvest's result fetch count is
+                        # unchanged by the diagnostics
+                        loss = jnp.concatenate(
+                            [jnp.reshape(loss, (1,)), diag])
+                    else:
+                        self.state, loss, priorities = self._step_fn(
+                            self.state, dev_batch)
                 for arr in (loss, priorities):
                     try:
                         arr.copy_to_host_async()
@@ -436,7 +486,14 @@ class Learner:
             interconnect round trip on the loop per dispatch regardless of
             pipeline depth."""
             meta, losses, priorities = item
-            flat = jnp.concatenate([losses, priorities.reshape(-1)])
+            if self._lh:
+                # the learnhealth diag rows ride the SAME flat result
+                # vector — one fetch per dispatch, unchanged
+                (losses, diags) = losses
+                flat = jnp.concatenate([losses, priorities.reshape(-1),
+                                        diags.reshape(-1)])
+            else:
+                flat = jnp.concatenate([losses, priorities.reshape(-1)])
             try:
                 flat.copy_to_host_async()
             except Exception:
@@ -450,13 +507,18 @@ class Learner:
                 # one D2H fetch for everything the host needs (usually
                 # already prefetched by prepare())
                 flat = np.asarray(jax.device_get(flat))
-            self._feed_back(meta, flat[:k], flat[k:].reshape(k, B),
-                            priority_sink, losses_hist)
+            diags = (flat[k + k * B:].reshape(k, -1) if self._lh else None)
+            self._feed_back(meta, flat[:k], flat[k:k + k * B].reshape(k, B),
+                            priority_sink, losses_hist, diags)
 
         def dispatch(ints, weights):
             with tracer.span("learner.step_dispatch"):
-                return compiled(self.state, ring.snapshot(),
-                                jnp.asarray(ints), jnp.asarray(weights))
+                out = compiled(self.state, ring.snapshot(),
+                               jnp.asarray(ints), jnp.asarray(weights))
+                if self._lh:
+                    st, losses, priorities, diags = out
+                    return st, (losses, diags), priorities
+                return out
 
         def sample():
             with tracer.span("learner.sample_meta"):
@@ -636,8 +698,12 @@ class Learner:
                     idx = jnp.asarray(
                         dispatch_no[0] & 0xFFFFFFFF, jnp.uint32)
                     dispatch_no[0] += 1
-                    st, new_prios, losses = compiled(
-                        self.state, *ring_args(), idx)
+                    out = compiled(self.state, *ring_args(), idx)
+                    if self._lh:
+                        st, new_prios, losses, diags = out
+                        losses = (losses, diags)
+                    else:
+                        st, new_prios, losses = out
                     store_prios(new_prios)
                     env_steps = buffer.env_steps
             # losses ride the pipeline; priorities never leave the device
@@ -646,6 +712,10 @@ class Learner:
 
         def prepare(item):
             meta, losses, _ = item
+            if self._lh:
+                # fold losses + diag rows into the dispatch's ONE D2H
+                losses, diags = losses
+                losses = jnp.concatenate([losses, diags.reshape(-1)])
             try:
                 losses.copy_to_host_async()
             except Exception:
@@ -655,9 +725,10 @@ class Learner:
         def harvest(item) -> None:
             meta, losses = item
             with tracer.span("learner.result_sync"):
-                losses_np = np.asarray(jax.device_get(losses))
-            assert np.isfinite(losses_np).all(), (
-                f"non-finite loss in super-step: {losses_np}")
+                flat = np.asarray(jax.device_get(losses))
+            losses_np = flat[:k]
+            diags = flat[k:].reshape(k, -1) if self._lh else None
+            self._note_results(losses_np, diags)
             self.env_steps = int(meta["env_steps"])
             buffer.note_updates(losses_np.shape[0], losses_np.sum())
             losses_hist.extend(losses_np.tolist())
@@ -727,10 +798,10 @@ class Learner:
 
     def _feed_back(self, meta, losses_np: np.ndarray, prios_np: np.ndarray,
                    priority_sink: Optional[PrioritySink],
-                   losses_hist: deque) -> None:
+                   losses_hist: deque,
+                   diags_np: Optional[np.ndarray] = None) -> None:
         """Route one harvested super-step's results to the host side."""
-        assert np.isfinite(losses_np).all(), (
-            f"non-finite loss in super-step: {losses_np}")
+        self._note_results(losses_np, diags_np)
         self.env_steps = int(meta["env_steps"])
         if priority_sink is not None:
             for j in range(losses_np.shape[0]):
@@ -842,12 +913,16 @@ class Learner:
             return item
 
         def harvest(item) -> None:
-            meta, losses, priorities = item
+            # dispatch() folded losses (+ learnhealth diag rows) into
+            # one flat replicated vector — ONE fetch either way
+            meta, flat, priorities = item
             with tracer.span("learner.result_sync"):
-                losses_np = np.asarray(jax.device_get(losses))  # replicated
+                flat_np = np.asarray(jax.device_get(flat))
                 prios_np = local_rows(priorities, axis=1)       # (k, B_host)
+            losses_np = flat_np[:k]
+            diags_np = flat_np[k:].reshape(k, -1) if self._lh else None
             self._feed_back(meta, losses_np, prios_np, priority_sink,
-                            losses_hist)
+                            losses_hist, diags_np)
 
         gate = self._collective_gate(buffer, stop)
 
@@ -867,7 +942,16 @@ class Learner:
                     offset=owned.start * (B // self.mesh.shape["dp"]))
                 ring_view = assemble_global(ring_sh, ring.snapshot(),
                                             global_blocks)
-                return compiled(self.state, ring_view, g_ints, g_w)
+                out = compiled(self.state, ring_view, g_ints, g_w)
+                if self._lh:
+                    # fold losses + diag rows into ONE flat replicated
+                    # vector so the harvest's result sync stays a
+                    # single fetch with diagnostics armed
+                    st, losses, priorities, diags = out
+                    return (st,
+                            jnp.concatenate([losses, diags.reshape(-1)]),
+                            priorities)
+                return out
 
         def sample():
             with tracer.span("learner.sample_meta"):

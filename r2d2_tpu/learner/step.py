@@ -162,7 +162,10 @@ def _loss_net(cfg: Config, net: R2D2Network) -> R2D2Network:
 
 
 def loss_and_priorities(cfg: Config, net: R2D2Network, params, target_params,
-                        batch: Dict[str, jnp.ndarray]):
+                        batch: Dict[str, jnp.ndarray], with_aux: bool = False):
+    """``with_aux`` additionally returns the forward-pass intermediates
+    the learnhealth diagnostics consume ``(td, mask, q_learn, max_abs_q)``
+    — stop-gradiented values, never a second forward."""
     q_online, q_target_seq = _double_unroll(cfg, net, params, target_params,
                                             batch)
 
@@ -192,22 +195,43 @@ def loss_and_priorities(cfg: Config, net: R2D2Network, params, target_params,
     loss = jnp.where(mask, weighted_sq, 0.0).sum() / jnp.maximum(valid, 1)
 
     priorities = mixed_priorities(jnp.abs(td), mask, batch["learning"])
-    return loss, priorities
+    if not with_aux:
+        return loss, priorities
+    aux = jax.lax.stop_gradient(
+        (td, mask, q_learn, jnp.abs(q_online).max()))
+    return loss, (priorities, aux)
 
 
-def make_train_step(cfg: Config, net: R2D2Network):
+def make_train_step(cfg: Config, net: R2D2Network,
+                    learnhealth: bool = False):
     """Returns ``train_step(state, batch) -> (state, loss, priorities)``
     — the pure function.  The ONE place it is jitted is
     ``parallel/sharding.pjit_train_step`` (table-driven shardings,
-    state+batch donation); a 1-device mesh is the single-device case."""
+    state+batch donation); a 1-device mesh is the single-device case.
+
+    ``learnhealth`` (and ``cfg.learnhealth_interval > 0``) appends the
+    in-graph diagnostic vector (telemetry/learnhealth.py) to the
+    signature: ``-> (state, loss, priorities, diag (DIAG_SIZE,) f32)``.
+    The diagnostics — including the paper's ΔQ zero-state re-unroll —
+    run under ``lax.cond`` on the step counter, so the
+    ``learnhealth_interval - 1`` disarmed steps between cadence points
+    pay only a zeros fill."""
     opt = make_optimizer(cfg)
     net = _loss_net(cfg, net)  # grad paths always run the scan recurrence
+    lh = learnhealth and getattr(cfg, "learnhealth_interval", 0) > 0
+    if lh:
+        from r2d2_tpu.telemetry.learnhealth import DIAG_SIZE, make_diag_fn
+
+        diag_fn = make_diag_fn(cfg, net)
 
     def train_step(state: TrainState, batch: Dict[str, jnp.ndarray]):
         grad_fn = jax.value_and_grad(
-            lambda p: loss_and_priorities(cfg, net, p, state.target_params, batch),
+            lambda p: loss_and_priorities(cfg, net, p, state.target_params,
+                                          batch, with_aux=lh),
             has_aux=True)
         (loss, priorities), grads = grad_fn(state.params)
+        if lh:
+            priorities, aux = priorities
         updates, new_opt_state = opt.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
@@ -219,12 +243,22 @@ def make_train_step(cfg: Config, net: R2D2Network):
         new_state = TrainState(step=step, params=new_params,
                                target_params=new_target,
                                opt_state=new_opt_state)
-        return new_state, loss, priorities
+        if not lh:
+            return new_state, loss, priorities
+        armed = (step % cfg.learnhealth_interval) == 0
+        diag = jax.lax.cond(
+            armed,
+            lambda op: diag_fn(*op),
+            lambda op: jnp.zeros((DIAG_SIZE,), jnp.float32),
+            (state.params, batch, loss, grads, updates, new_params,
+             new_target, aux))
+        return new_state, loss, priorities, diag
 
     return train_step
 
 
-def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
+def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None,
+                       learnhealth: bool = False):
     """The unjitted ``k``-fused-steps function — batches gathered in-graph
     from the device-resident replay ring (replay/device_ring.py).
 
@@ -240,25 +274,34 @@ def make_super_step_fn(cfg: Config, net: R2D2Network, k: int, gather=None):
     no hand-written shard_map variant since r9).
 
     Signature: ``super_step(state, ring_arrays, ints (k,B,6) i32,
-    is_weights (k,B) f32) -> (state, losses (k,), priorities (k,B))``.
-    Jitted only by ``parallel/sharding.pjit_super_step`` (table-driven
-    shardings; a 1-device mesh is the single-device case).
+    is_weights (k,B) f32) -> (state, losses (k,), priorities (k,B))``
+    (``learnhealth``: ``+ diags (k, DIAG_SIZE)`` — the per-inner-step
+    diagnostic vectors, zeros off-cadence).  Jitted only by
+    ``parallel/sharding.pjit_super_step`` (table-driven shardings; a
+    1-device mesh is the single-device case).
     """
     from r2d2_tpu.replay.device_ring import gather_batch
 
     if gather is None:
         gather = functools.partial(gather_batch, cfg)
-    step = make_train_step(cfg, net)
+    lh = learnhealth and getattr(cfg, "learnhealth_interval", 0) > 0
+    step = make_train_step(cfg, net, learnhealth=lh)
 
     def super_step(state: TrainState, arrays, ints, is_weights):
         def body(st, x):
             ints_t, w_t = x
             batch = gather(arrays, ints_t, w_t)
+            if lh:
+                st, loss, priorities, diag = step(st, batch)
+                return st, (loss, priorities, diag)
             st, loss, priorities = step(st, batch)
             return st, (loss, priorities)
 
-        state, (losses, priorities) = jax.lax.scan(
-            body, state, (ints, is_weights))
+        state, ys = jax.lax.scan(body, state, (ints, is_weights))
+        if lh:
+            losses, priorities, diags = ys
+            return state, losses, priorities, diags
+        losses, priorities = ys
         return state, losses, priorities
 
     return super_step
@@ -364,7 +407,8 @@ def _in_graph_sample(cfg: Config, key, prios, seq_meta, first_burn,
 
 def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
                                     constrain=None,
-                                    replicate_for_draw=None):
+                                    replicate_for_draw=None,
+                                    learnhealth: bool = False):
     """``k`` fused steps with DEVICE-side PER: sample → gather → step →
     priority scatter, all inside one dispatch.
 
@@ -379,14 +423,16 @@ def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
 
     Signature: ``super_step(state, ring_arrays, prios (NB*K,) f32
     [donated], seq_meta (NB,K,3) i32, first_burn (NB,) i32,
-    dispatch_idx u32) -> (state, prios', losses (k,))``.  The sampling
+    dispatch_idx u32) -> (state, prios', losses (k,))``
+    (``learnhealth``: ``+ diags (k, DIAG_SIZE)``).  The sampling
     stream is ``fold_in(PRNGKey(cfg.seed), dispatch_idx)`` — distinct per
     dispatch with no seed/counter bit-packing to alias or overflow.
     Jitted only by ``parallel/sharding.pjit_in_graph_per_super_step``.
     """
     from r2d2_tpu.replay.device_ring import gather_batch
 
-    step = make_train_step(cfg, net)
+    lh = learnhealth and getattr(cfg, "learnhealth_interval", 0) > 0
+    step = make_train_step(cfg, net, learnhealth=lh)
 
     def super_step(state: TrainState, arrays, prios, seq_meta, first_burn,
                    dispatch_idx):
@@ -415,14 +461,20 @@ def make_in_graph_per_super_step_fn(cfg: Config, net: R2D2Network, k: int,
                 # host-sampled path's dp-sharded H2D bundles do
                 ints_t, w = constrain(ints_t, w)
             batch = gather_batch(cfg, arrays, ints_t, w)
-            st, loss, new_p = step(st, batch)
+            if lh:
+                st, loss, new_p, diag = step(st, batch)
+            else:
+                st, loss, new_p = step(st, batch)
             # feedback: same exponentiation the host tree applies
             # (sum_tree.py:60); duplicate-idx writes resolve arbitrarily,
             # as does the host's sequential last-wins — both harmless
             p = p.at[idx].set(new_p ** cfg.prio_exponent)
-            return (st, p), loss
+            return (st, p), ((loss, diag) if lh else loss)
 
-        (state, prios), losses = jax.lax.scan(body, (state, prios), keys)
-        return state, prios, losses
+        (state, prios), ys = jax.lax.scan(body, (state, prios), keys)
+        if lh:
+            losses, diags = ys
+            return state, prios, losses, diags
+        return state, prios, ys
 
     return super_step

@@ -94,6 +94,7 @@ from r2d2_tpu.replay.block import (
     slot_views,
     write_block,
 )
+from r2d2_tpu.telemetry.learnhealth import PRIO_EDGES, replay_ratio
 from r2d2_tpu.telemetry.registry import MetricsRegistry
 from r2d2_tpu.telemetry.slab import CounterMerger, StatsSlab, StatsSlabWriter
 from r2d2_tpu.telemetry.tracing import EVENTS
@@ -107,6 +108,10 @@ log = logging.getLogger(__name__)
 # seq + CRC, torn publishes keep the previous good reading).  Counters
 # are SESSION-LOCAL (an incarnation starts them at zero even after a
 # snapshot restore) so the CounterMerger's respawn fold stays exact.
+# The trailing gauges are the per-shard replay data-health view
+# (telemetry/learnhealth.py): PER effective sample size + the
+# fixed-bucket priority histogram, refreshed at most once a second by
+# the owner (the leaf walk is not per-publish work).
 SHARD_STAT_FIELDS: Tuple[Tuple[str, str], ...] = (
     ("tree_mass", "gauge"),
     ("size", "gauge"),
@@ -115,7 +120,11 @@ SHARD_STAT_FIELDS: Tuple[Tuple[str, str], ...] = (
     ("samples", "counter"),
     ("prio_updates", "counter"),
     ("incarnation", "gauge"),
-)
+    ("ess", "gauge"),
+    ("ess_frac", "gauge"),
+    ("positive_leaves", "gauge"),
+) + tuple((f"prio_hist_{i}", "gauge")
+          for i in range(len(PRIO_EDGES) + 1))
 
 _SAVE_DRAIN_BUDGET = 15.0   # seconds a shard waits to consume every
                             # routed block/feedback before snapshotting
@@ -294,6 +303,22 @@ def _shard_worker_main(cfg: Config, action_dim: int, shard_id: int,
     # session-local counters (start at zero every incarnation, even after
     # a restore — the trainer's CounterMerger folds across respawns)
     counters = dict(blocks=0, corrupt=0, samples=0, prio_updates=0)
+    # per-shard data-health gauges (learnhealth plane): the ESS/histogram
+    # leaf walk is refreshed at most once a second, NOT per publish —
+    # publish fires per event-loop progress tick
+    health = {"t": float("-inf"), "vals": {}}
+
+    def data_health_vals() -> dict:
+        now = time.monotonic()
+        if now - health["t"] > 1.0:
+            pr = buffer.data_health()["priorities"]
+            vals = dict(ess=pr["ess"], ess_frac=pr["ess_frac"],
+                        positive_leaves=pr["positive_leaves"])
+            for i, c in enumerate(pr["hist"]):
+                vals[f"prio_hist_{i}"] = c
+            health["vals"] = vals
+            health["t"] = now
+        return health["vals"]
 
     def publish() -> None:
         if trace_info is not None:
@@ -305,7 +330,7 @@ def _shard_worker_main(cfg: Config, action_dim: int, shard_id: int,
             corrupt_blocks=counters["corrupt"],
             samples=counters["samples"],
             prio_updates=counters["prio_updates"],
-            incarnation=incarnation))
+            incarnation=incarnation, **data_health_vals()))
 
     def ingest_once() -> bool:
         try:
@@ -1113,6 +1138,37 @@ class ShardedReplayPlane:
             if meta.get("rng_state") is not None:
                 self.rng.bit_generator.state = meta["rng_state"]
         self._armed_restore = (path, meta)
+
+    # ---------------------------------------------------------- data health
+    def data_health(self) -> Dict[str, Any]:
+        """Learning-health view of the sharded plane: one data-health
+        row PER SHARD (ESS + priority histogram, published by each owner
+        through the stats slab) plus the plane-level replay-ratio gauge.
+        Per-member sample fractions live shard-side (the preassembled
+        response rows carry no member word) — ``samples_per_member`` is
+        empty here; ``blocks_per_member`` via the population plane
+        remains the member-flow proof (docs/OBSERVABILITY.md)."""
+        st = self.poll_shard_stats()
+        with self._lock:
+            training_steps = self.training_steps
+            env_steps = self.env_steps
+        shards = []
+        for s, row in enumerate(st["per_shard"]):
+            shards.append(dict(
+                shard=s,
+                ess=float(row.get("ess", 0.0)),
+                ess_frac=float(row.get("ess_frac", 0.0)),
+                positive_leaves=int(row.get("positive_leaves", 0)),
+                mass=float(row.get("tree_mass", 0.0)),
+                hist=[int(row.get(f"prio_hist_{i}", 0))
+                      for i in range(len(PRIO_EDGES) + 1)],
+            ))
+        return dict(
+            replay_ratio=replay_ratio(self.cfg, training_steps, env_steps),
+            samples_per_member={},
+            edges=list(PRIO_EDGES),
+            shards=shards,
+        )
 
     # --------------------------------------------------------------- stats
     def stats(self) -> Dict[str, float]:

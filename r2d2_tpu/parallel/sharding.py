@@ -329,6 +329,11 @@ def pjit_train_step(cfg, net, table: Optional[ShardingTable] = None,
 
     ``state_template`` (a live TrainState or its avals) derives the
     per-leaf shardings; retrace-guarded as ``learner.train_step``.
+
+    ``cfg.learnhealth_interval > 0`` appends the replicated in-graph
+    diagnostic vector to the outputs (telemetry/learnhealth.py) — the
+    drivetrains fold it into their existing result fetch; with the
+    default 0 the compiled program is unchanged.
     """
     from r2d2_tpu.learner.step import make_train_step
 
@@ -340,12 +345,17 @@ def pjit_train_step(cfg, net, table: Optional[ShardingTable] = None,
                          "resolve per-leaf shardings from the table")
     _silence_benign_donation_warning()
     _check_batch(cfg, table.mesh)
+    lh = cfg.learnhealth_interval > 0
     st_sh = table.state_shardings(state_template)
     dp_rows = NamedSharding(table.mesh, P("dp"))
+    out_sh = (st_sh, table.replicated(), dp_rows)
+    if lh:
+        out_sh = out_sh + (table.replicated(),)
     return jax.jit(
-        RETRACES.wrap("learner.train_step", make_train_step(cfg, net)),
+        RETRACES.wrap("learner.train_step",
+                      make_train_step(cfg, net, learnhealth=lh)),
         in_shardings=(st_sh, table.batch_shardings()),
-        out_shardings=(st_sh, table.replicated(), dp_rows),
+        out_shardings=out_sh,
         donate_argnums=(0, 1) if donate_batch else (0,),
     )
 
@@ -369,13 +379,18 @@ def pjit_super_step(cfg, net, table: ShardingTable, k: int,
                          "the table layout")
     _silence_benign_donation_warning()
     _check_batch(cfg, table.mesh)
+    lh = cfg.learnhealth_interval > 0
     st_sh = table.state_shardings(state_template)
     dp_b = NamedSharding(table.mesh, P(None, "dp"))
+    out_sh = (st_sh, table.replicated(), dp_b)
+    if lh:
+        # the (k, DIAG_SIZE) learnhealth diagnostic rows, replicated
+        out_sh = out_sh + (table.replicated(),)
     return jax.jit(
         RETRACES.wrap("learner.super_step",
-                      make_super_step_fn(cfg, net, k)),
+                      make_super_step_fn(cfg, net, k, learnhealth=lh)),
         in_shardings=(st_sh, table.ring_shardings(layout), dp_b, dp_b),
-        out_shardings=(st_sh, table.replicated(), dp_b),
+        out_shardings=out_sh,
         donate_argnums=(0, 2, 3),
     )
 
@@ -420,15 +435,20 @@ def pjit_in_graph_per_super_step(cfg, net, table: ShardingTable, k: int,
         return jax.lax.with_sharding_constraint(p, rep)
 
     per = table.per_shardings(layout)
+    lh = cfg.learnhealth_interval > 0
+    out_sh = (st_sh, per["prios"], table.replicated())
+    if lh:
+        # the (k, DIAG_SIZE) learnhealth diagnostic rows, replicated
+        out_sh = out_sh + (table.replicated(),)
     return jax.jit(
         RETRACES.wrap(
             "learner.in_graph_per_super_step",
             make_in_graph_per_super_step_fn(
                 cfg, net, k, constrain=constrain,
-                replicate_for_draw=replicate_for_draw)),
+                replicate_for_draw=replicate_for_draw, learnhealth=lh)),
         in_shardings=(st_sh, table.ring_shardings(layout), per["prios"],
                       per["seq_meta"], per["first"], table.replicated()),
-        out_shardings=(st_sh, per["prios"], table.replicated()),
+        out_shardings=out_sh,
         donate_argnums=(0, 2),
     )
 

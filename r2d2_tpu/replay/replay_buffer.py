@@ -188,6 +188,15 @@ class ReplayBuffer:
         self.tree = SumTree(cfg.num_sequences, cfg.prio_exponent,
                             cfg.importance_sampling_exponent, rng=rng)
 
+        # data-health sidecar (telemetry/learnhealth.py): the resident
+        # block's member id per physical slot + cumulative sampled-row
+        # counts per member — the replay-side proof that every
+        # population member's experience is actually being TRAINED on,
+        # not just stored.  Not part of the snapshot layout (a resume
+        # recounts from its warm ring's new adds/draws).
+        self._slot_member = np.zeros(cfg.num_blocks, np.int32)
+        self.samples_per_member: Dict[int, int] = {}
+
         # block-lineage sidecar (telemetry/tracing.py): per PHYSICAL slot,
         # the resident block's cut/add wall-clock stamps (feed the
         # pipeline.block_age_at_train_s / pipeline.hop.* histograms) and
@@ -332,6 +341,7 @@ class ReplayBuffer:
             self._slot_add_ts[slot] = time.time()
             self._slot_trace[slot] = block.trace_id
             m = int(block.member_id)
+            self._slot_member[slot] = m
             self.blocks_per_member[m] = self.blocks_per_member.get(m, 0) + 1
             if episode_reward is not None:
                 self.episode_reward += episode_reward
@@ -365,6 +375,7 @@ class ReplayBuffer:
                     "sample_batch on an empty buffer; wait for add() (use "
                     "`ready` to gate on learning_starts)")
             idxes, is_weights = self.tree.sample(B)
+            self._note_sampled(idxes)
             batch = dict(
                 self._gather_rows(idxes),
                 is_weights=is_weights.astype(np.float32),
@@ -377,6 +388,16 @@ class ReplayBuffer:
             _emit_flows("replay.sample",
                         self._slot_trace[idxes // cfg.seqs_per_block], "t")
         return batch
+
+    def _note_sampled(self, idxes: np.ndarray) -> None:
+        """Count sampled rows per resident member (caller holds the
+        lock) — the per-member sample fractions of the data-health
+        surface."""
+        members = self._slot_member[idxes // self.cfg.seqs_per_block]
+        for m, c in zip(*np.unique(members, return_counts=True)):
+            m = int(m)
+            self.samples_per_member[m] = (
+                self.samples_per_member.get(m, 0) + int(c))
 
     def _row_ages(self, idxes: np.ndarray) -> np.ndarray:
         """(n, 2) float32 per-row block ages at gather time — seconds
@@ -495,6 +516,7 @@ class ReplayBuffer:
                 # redistributes the rows over the shards that have mass
                 return None
             idxes, prios = self.tree.sample(n, raw=True)
+            self._note_sampled(idxes)
             rows = self._gather_rows(idxes, out=out)
             ages = self._row_ages(idxes)
         if EVENTS.armed:
@@ -576,6 +598,7 @@ class ReplayBuffer:
                 ints[j, :, 5] = self.forward_steps[block_idx, seq_idx]
                 weights[j] = w
                 idxes[j] = idx
+                self._note_sampled(idx)
             meta = dict(ints=ints, is_weights=weights, idxes=idxes,
                         block_ptr=self.block_ptr, env_steps=self.env_steps)
             if dispatch is not None:
@@ -746,6 +769,40 @@ class ReplayBuffer:
                 self.tree.rng.bit_generator.state = meta["rng_state"]
         del views
         del mm
+
+    # ---------------------------------------------------------- data health
+    def data_health(self) -> Dict[str, Any]:
+        """Learning-health view of the replay plane (telemetry/
+        learnhealth.py; docs/OBSERVABILITY.md `learnhealth.replay.*`):
+        the PER distribution's effective sample size + fixed-bucket
+        priority histogram over the sum-tree leaves, the cumulative
+        replay-ratio gauge (samples consumed per transition inserted),
+        and per-member sampled-row counts (the ``member_id`` stamp).
+
+        Under ``in_graph_per`` the priority leaves live on-device (the
+        host tree stays empty) — ``priorities`` is then None; fetching
+        the leaf vector per log interval would race the dispatch loop's
+        donated handles, so the device-PER plane reports ratio/member
+        flow only (documented in docs/OBSERVABILITY.md)."""
+        from r2d2_tpu.telemetry.learnhealth import (
+            priority_health,
+            replay_ratio,
+        )
+
+        cfg = self.cfg
+        in_graph = (getattr(cfg, "in_graph_per", False)
+                    and self.device_ring is not None)
+        with self.lock:
+            leaves = None if in_graph else self.tree.leaf_values()
+            training_steps = self.training_steps
+            env_steps = self.env_steps
+            samples = dict(self.samples_per_member)
+        out: Dict[str, Any] = dict(
+            replay_ratio=replay_ratio(cfg, training_steps, env_steps),
+            samples_per_member=samples,
+            priorities=None if leaves is None else priority_health(leaves),
+        )
+        return out
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> Dict[str, float]:

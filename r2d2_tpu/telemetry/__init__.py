@@ -25,7 +25,17 @@ The observability subsystem (docs/OBSERVABILITY.md):
   singleton's attach-in-place semantics stay unambiguous.
 - :mod:`~r2d2_tpu.telemetry.plane` — the per-run orchestrator
   (``Telemetry``) that ``train()`` wires through the fabric.
+- :mod:`~r2d2_tpu.telemetry.learnhealth` — the learning-health plane:
+  in-graph train-step diagnostics (ΔQ, |TD|/IS histograms, norms, the
+  NaN sentry), replay data-health (PER ESS / priority histograms /
+  replay ratio / member fractions), and the declarative alert engine
+  (``alerts.jsonl`` + ``/alertz`` + ``learnhealth.alert{rule}``).
 """
+from r2d2_tpu.telemetry.learnhealth import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+    LearnHealthMonitor,
+)
 from r2d2_tpu.telemetry.console import format_entry  # noqa: F401
 from r2d2_tpu.telemetry.exporter import (  # noqa: F401
     TelemetryExporter,

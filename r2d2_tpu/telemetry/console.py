@@ -49,6 +49,16 @@ def format_entry(entry: Dict[str, Any], prefix: str = "[r2d2]") -> str:
             line += f" shard_timeouts={rs['sample_timeouts']}"
     if entry.get("corrupt_blocks"):
         line += f" corrupt_blocks={entry['corrupt_blocks']}"
+    lh = entry.get("learnhealth") or {}
+    if lh.get("armed_steps") and lh.get("dq_mean") is not None:
+        # the paper's stored-vs-recomputed-state ΔQ, from the newest
+        # armed in-graph diagnostic (telemetry/learnhealth.py)
+        line += f" dq={lh['dq_mean']:.4f}"
+    alerts = entry.get("alerts") or {}
+    fired = {k: v for k, v in alerts.items() if v}
+    if fired:
+        line += " ALERTS[" + ",".join(
+            f"{k}={v}" for k, v in sorted(fired.items())) + "]"
     age = entry.get("learner_heartbeat_age")
     if age is not None and age > 5.0:
         line += f" heartbeat_age={age:.1f}s"

@@ -274,6 +274,72 @@ class Telemetry:
             reg.counter_max("actor.blocks_produced", an.get("blocks", 0))
             reg.counter_max("actor.episodes", an.get("episodes_total", 0))
             reg.set_gauge("anakin.ring_fill", entry.get("buffer_size", 0))
+        # learning-health plane (telemetry/learnhealth.py): the
+        # monitor's snapshot — latest armed in-graph diag scalars as
+        # gauges, cumulative sentry/spike counters, and the |TD| /
+        # IS-weight histograms absorbed bucketwise-monotone.  Alert
+        # fires are NOT re-absorbed here: the AlertEngine stamps
+        # learnhealth.alert{rule} at the fire site (the fleet.respawns
+        # rule — the log loop may never tick again after a trip)
+        lh = entry.get("learnhealth")
+        if lh:
+            reg.absorb_counters("learnhealth", {
+                k: lh[k] for k in ("armed_steps", "nonfinite",
+                                   "loss_spikes", "loss_count")
+                if k in lh})
+            reg.absorb_gauges("learnhealth", {
+                k: lh[k] for k in ("loss_ewma", "dq_ewma", "dq_mean",
+                                   "dq_max", "grad_norm", "update_norm",
+                                   "param_norm", "target_lag",
+                                   "max_abs_q")
+                if isinstance(lh.get(k), (int, float))})
+            from r2d2_tpu.telemetry.learnhealth import (
+                IS_WEIGHT_EDGES,
+                TD_ABS_EDGES,
+            )
+
+            if lh.get("td_hist"):
+                reg.absorb_histogram("learnhealth.td_abs", TD_ABS_EDGES,
+                                     lh["td_hist"],
+                                     total=lh.get("td_sum"))
+            if lh.get("is_hist"):
+                reg.absorb_histogram("learnhealth.is_weight",
+                                     IS_WEIGHT_EDGES, lh["is_hist"],
+                                     total=lh.get("is_sum"))
+        # replay data-health: the PER distribution's ESS + priority
+        # histogram (per ring, or per shard on the sharded plane), the
+        # replay-ratio gauge, per-member sample fractions
+        rh = entry.get("replay_health")
+        if rh:
+            reg.set_gauge("learnhealth.replay.ratio",
+                          rh.get("replay_ratio", 0.0))
+            spm = rh.get("samples_per_member") or {}
+            total_s = sum(spm.values())
+            if total_s:
+                for m, c in spm.items():
+                    reg.set_gauge("learnhealth.replay.sample_fraction",
+                                  c / total_s, member=str(m))
+
+            def _prio_row(row, **lbl):
+                reg.set_gauge("learnhealth.replay.ess",
+                              row.get("ess", 0.0), **lbl)
+                reg.set_gauge("learnhealth.replay.ess_frac",
+                              row.get("ess_frac", 0.0), **lbl)
+                reg.set_gauge("learnhealth.replay.positive_leaves",
+                              row.get("positive_leaves", 0), **lbl)
+                edges = list(row.get("edges", rh.get("edges") or []))
+                for i, c in enumerate(row.get("hist", [])):
+                    le = (str(edges[i]) if i < len(edges) else "+Inf")
+                    # snapshot of the CURRENT leaf distribution (not a
+                    # cumulative counter): per-bucket gauges, le label
+                    reg.set_gauge("learnhealth.replay.priorities", c,
+                                  le=le, **lbl)
+
+            if rh.get("shards") is not None:
+                for row in rh["shards"]:
+                    _prio_row(row, shard=str(row.get("shard", 0)))
+            elif rh.get("priorities"):
+                _prio_row(rh["priorities"])
         # the runtime guard surfaces (utils/trace.py process-wide views)
         from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
 

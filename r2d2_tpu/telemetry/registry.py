@@ -142,6 +142,33 @@ class MetricsRegistry:
             h.total += float(values.sum())
             h.count += int(values.size)
 
+    def absorb_histogram(self, name: str, bounds: Sequence[float],
+                         counts: Sequence[float],
+                         total: Optional[float] = None, **labels) -> None:
+        """Absorb an ABSOLUTE cumulative bucket-count vector from a
+        monotone external source (e.g. the learnhealth diag's in-graph
+        |TD| histogram): per-bucket max-merge — the :meth:`counter_max`
+        idempotence rule applied bucketwise, so re-absorbing the same
+        snapshot never double-counts and a restarted scrape never drags
+        a bucket backwards.  ``counts`` must align to ``bounds`` plus
+        the trailing +Inf bucket; ``total`` is the histogram's running
+        value sum (kept monotone the same way)."""
+        bounds_f = [float(b) for b in bounds]
+        if len(counts) != len(bounds_f) + 1:
+            raise ValueError(
+                f"histogram {name!r}: {len(counts)} counts for "
+                f"{len(bounds_f)} bounds (+Inf bucket expected)")
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None or h.bounds != bounds_f:
+                h = self._histograms[key] = _Histogram(bounds_f)
+            for i, c in enumerate(counts):
+                h.counts[i] = max(h.counts[i], int(c))
+            h.count = sum(h.counts)
+            if total is not None:
+                h.total = max(h.total, float(total))
+
     # bulk absorption of the pre-existing flat-dict surfaces ---------------
     def absorb_gauges(self, prefix: str,
                       mapping: Mapping[str, float], **labels) -> None:

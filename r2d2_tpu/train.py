@@ -392,13 +392,29 @@ class _HostScaffold:
         self._stop_fn = stop_fn
         self.checkpoint_dir = checkpoint_dir
         self.telemetry = Telemetry(cfg, checkpoint_dir)
+        # learning-health plane (telemetry/learnhealth.py): the alert
+        # engine owns the declarative rule set, the learnhealth.alert
+        # counters, the durable alerts.jsonl stream and /alertz; the
+        # monitor absorbs harvested losses + in-graph diag vectors on
+        # the learner thread and trips a clean fabric stop on
+        # non-finite numerics (stop() below polls it)
+        from r2d2_tpu.telemetry.learnhealth import (
+            AlertEngine,
+            LearnHealthMonitor,
+        )
+
+        self.alerts = AlertEngine(
+            cfg, self.telemetry.registry,
+            log_dir=(os.path.join(checkpoint_dir, "telemetry")
+                     if checkpoint_dir else None))
+        self.learnhealth = LearnHealthMonitor(cfg, engine=self.alerts)
         # on-demand capture plane (telemetry/tracing.py), armed by
         # tracing_loops(); exporter_loops() then exposes its /tracez +
-        # /profilez trigger routes
+        # /profilez trigger routes next to /alertz
         self.trace_slab = None
         self.trace_ctl = None
         self.profile_ctl = None
-        self.trace_routes: Dict[str, Any] = {}
+        self.trace_routes: Dict[str, Any] = {"/alertz": self.alerts.route}
         # a thread exhausting its restart budget is stamped straight into
         # the registry by the supervisor itself — the log loop (the usual
         # absorption path) may be the very thread that died
@@ -428,7 +444,26 @@ class _HostScaffold:
         return (self.stop_event.is_set() or self.supervisor.any_failed
                 or (self.deadline is not None
                     and time.time() > self.deadline)
+                # non-finite loss/grads: stop cleanly (drain-then-save)
+                # instead of training on through poisoned numerics —
+                # the nonfinite alert already fired at trip time
+                or self.learnhealth.tripped
                 or (self._stop_fn is not None and self._stop_fn()))
+
+    def record_learnhealth(self, entry: Dict[str, Any],
+                           replay_health: Optional[Dict[str, Any]] = None
+                           ) -> None:
+        """The log loops' shared learnhealth step: stamp the monitor
+        snapshot (+ replay data-health) into the entry, then run the
+        alert engine over it; the entry carries the cumulative alert
+        counts for /statusz, the JSONL record and r2d2_top."""
+        entry["learnhealth"] = self.learnhealth.snapshot()
+        if replay_health is not None:
+            entry["replay_health"] = replay_health
+        self.alerts.evaluate(dict(
+            learnhealth=entry["learnhealth"], replay=replay_health,
+            training_steps=entry.get("training_steps", 0)))
+        entry["alerts"] = self.alerts.counts()
 
     def install_signals(self) -> None:
         """SIGTERM/SIGINT request a drain-then-save shutdown.  Signals
@@ -517,7 +552,8 @@ class _HostScaffold:
                 return (409 if "error" in res else 200), res
             return 200, self.profile_ctl.status()
 
-        self.trace_routes = {"/tracez": tracez, "/profilez": profilez}
+        self.trace_routes.update({"/tracez": tracez,
+                                  "/profilez": profilez})
         if cfg.trace_steps > 0:
             self.trace_ctl.arm(cfg.trace_steps)
 
@@ -565,6 +601,7 @@ class _HostScaffold:
         self.supervisor.join_all(timeout=5.0)
 
     def close(self) -> None:
+        self.alerts.close()
         self.telemetry.close()
         if self.trace_slab is not None:
             # after the planes' shutdown (train's finally order): every
@@ -607,7 +644,11 @@ def train_sync(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                       # population members are process fleets and the
                       # eval sidecar is a fabric subprocess — neither
                       # exists in the deterministic single-thread path
-                      population_spec="", league_eval=False)
+                      population_spec="", league_eval=False,
+                      # no monitor/alert engine exists here either:
+                      # armed diagnostics would pay the in-graph ΔQ
+                      # re-unroll only to be discarded at harvest
+                      learnhealth_interval=0)
     sys = _build(cfg, env_factory, use_mesh, checkpoint_dir, resume)
     cfg = sys["cfg"]
     actor: VectorActor = sys["actor"]
@@ -750,6 +791,9 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
     heartbeat, stall, logs = (scaffold.heartbeat, scaffold.stall,
                               scaffold.logs)
     stop_event, stop = scaffold.stop_event, scaffold.stop
+    # learnhealth: the plane's harvest absorbs losses + the in-graph
+    # diag rows riding the fused program's flat result vector
+    plane.monitor = scaffold.learnhealth
     chaos = None
     if cfg.chaos_spec:
         from r2d2_tpu.utils.chaos import ChaosInjector
@@ -770,9 +814,14 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
         stale = (cfg.learner_stall_timeout > 0
                  and age > cfg.learner_stall_timeout)
         ok = not (supervisor.any_failed or stall["stalled"] or stale)
+        # the nonfinite alert rule is the ONE learnhealth signal that
+        # degrades /healthz: the checkpoint stream is numerically
+        # suspect and an operator must look (docs/OBSERVABILITY.md)
+        degraded = ok and scaffold.alerts.nonfinite_active
         return dict(ok=ok,
-                    degraded=False,   # no fallback planes: ok or failing
-                    status="ok" if ok else "failing",
+                    degraded=degraded,
+                    status=("failing" if not ok
+                            else "degraded" if degraded else "ok"),
                     learner_heartbeat_age=age,
                     learner_stalled=stall["stalled"] or stale,
                     threads=supervisor.health())
@@ -805,6 +854,10 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                             blocks=s["blocks"],
                             episodes_total=s["episodes_total"]),
             )
+            # learnhealth + alerts: the anakin PER leaves live in-graph
+            # (no host tree to walk), so no replay data-health here —
+            # the in-graph diag bundle covers the learner side
+            scaffold.record_learnhealth(entry)
             logs.append(entry)
             telemetry.record(entry)
             if log_sink is not None:
@@ -842,6 +895,14 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                     snapshot_fn=(save_anakin_snapshot if want_full_save
                                  else None), chaos=chaos)
         finally:
+            # final health verdict BEFORE quiesce (same rule as the
+            # threaded trainer): post-quiesce the heartbeat stops
+            # beating and the epilogue snapshot below can outlast the
+            # stall budget — a clean run must not misread as failing
+            try:
+                final_health = healthz()
+            except Exception:
+                final_health = {}
             scaffold.quiesce()
 
         # drain-then-save epilogue: the learner state was saved by
@@ -861,7 +922,10 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                        learner_stalled=stall["stalled"],
                        trace=tracer.snapshot(), health=supervisor.health(),
                        telemetry_port=telemetry.port,
-                       fabric_failed=supervisor.any_failed)
+                       fabric_failed=supervisor.any_failed,
+                       learnhealth=scaffold.learnhealth.snapshot(),
+                       alerts=scaffold.alerts.counts(),
+                       healthz=final_health)
         if chaos is not None:
             metrics["chaos"] = chaos.counts()
         return metrics
@@ -972,6 +1036,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
     heartbeat, stall, logs = (scaffold.heartbeat, scaffold.stall,
                               scaffold.logs)
     stop_event, stop = scaffold.stop_event, scaffold.stop
+    # learnhealth: the learner's harvests absorb losses + the in-graph
+    # diag vectors (cfg.learnhealth_interval); a non-finite observation
+    # fires the nonfinite alert and trips scaffold.stop
+    learner.monitor = scaffold.learnhealth
 
     chaos = None
     if cfg.chaos_spec:
@@ -1058,6 +1126,12 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             freeze = chaos.learner_freeze_seconds()
             if freeze > 0:
                 time.sleep(freeze)
+            if chaos.poison_params_now():
+                # learnhealth NaN-sentry drill: runs ON the learner
+                # thread (this predicate is only polled there), so the
+                # state handle cannot race an in-flight donation
+                log.warning("chaos: poisoning learner params with NaN")
+                learner.poison_params()
         heartbeat.beat()
         return stop()
 
@@ -1178,6 +1252,9 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             # failing — an orchestrator must not evict a training run
             # because its scoreboard died
             degraded = degraded or bool(lh["degraded"])
+        # learnhealth: the nonfinite alert rule (and only it) degrades
+        # the verdict — the checkpoint stream is numerically suspect
+        degraded = degraded or scaffold.alerts.nonfinite_active
         out["degraded"] = degraded and out["ok"]
         out["status"] = ("failing" if not out["ok"]
                          else "degraded" if degraded else "ok")
@@ -1223,6 +1300,14 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
             # the in-process path) so r2d2_top renders one line format
             entry["corrupt_blocks"] = s["corrupt_blocks"]
             entry["shard_respawns"] = s.get("shard_respawns", 0)
+            # learnhealth: monitor snapshot + replay data-health (ESS /
+            # priority histogram / replay ratio / member fractions),
+            # then the alert engine's interval evaluation
+            try:
+                replay_health = buffer.data_health()
+            except Exception:   # telemetry must never kill the log loop
+                replay_health = None
+            scaffold.record_learnhealth(entry, replay_health)
             logs.append(entry)
             # registry absorption + the persistent JSONL record
             telemetry.record(entry)
@@ -1366,6 +1451,13 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                     metrics = learner.run(batch_source, priority_sink,
                                           stop=learner_stop, tracer=tracer)
         finally:
+            # the run's final health verdict, sampled while every plane
+            # still exists (post-shutdown a plane reports alive=0, which
+            # would misread as degraded) — metrics["healthz"] below
+            try:
+                final_health = healthz()
+            except Exception:
+                final_health = {}
             scaffold.quiesce()
             league_final = None
             if sidecar is not None:
@@ -1416,7 +1508,10 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                        trace=tracer.snapshot(), health=supervisor.health(),
                        telemetry_port=telemetry.port,
                        fabric_failed=(supervisor.any_failed
-                                      or (plane is not None and plane.failed)))
+                                      or (plane is not None and plane.failed)),
+                       learnhealth=scaffold.learnhealth.snapshot(),
+                       alerts=scaffold.alerts.counts(),
+                       healthz=final_health)
         if chaos is not None:
             metrics["chaos"] = chaos.counts()
         if plane is not None:

@@ -72,6 +72,13 @@ recovery path the fabric claims to have can be exercised under load:
                       never a lockstep window a straggler can hold
                       hostage); the session either resumes or idle-
                       reaps.
+- ``poison_params`` — overwrite one learner param leaf with NaN on the
+                      learner thread (the learnhealth NaN-sentry drill,
+                      telemetry/learnhealth.py): the in-graph sentry /
+                      host loss check must fire the ``nonfinite`` alert,
+                      degrade /healthz and stop the fabric CLEANLY
+                      (drain-then-save) instead of crashing the learner
+                      or training on through poisoned numerics.
 - ``kill_eval_sidecar`` — (league plane, ``cfg.league_eval``) SIGKILL
                       the standing eval sidecar mid-sweep; the
                       ``eval_watch`` loop must respawn it with its
@@ -115,7 +122,7 @@ _KINDS = ("kill_fleet", "garble_block", "truncate_ckpt", "freeze_learner",
           "freeze_service", "drop_act_response", "garble_act_response",
           "stall_pump", "wedge_dispatch", "kill_replay_shard",
           "garble_sample_response", "stall_shard", "kill_session_client",
-          "slow_session_client", "kill_eval_sidecar")
+          "slow_session_client", "kill_eval_sidecar", "poison_params")
 
 
 def parse_spec(spec: str) -> Dict[str, Dict[str, float]]:
@@ -327,6 +334,14 @@ class ChaosInjector:
         log.warning("chaos: SIGKILL eval sidecar (pid %s)", p.pid)
         p.kill()
         return True
+
+    def poison_params_now(self) -> bool:
+        """One opportunity per learner stop-poll: True = the trainer
+        must overwrite one param leaf with NaN (``Learner.poison_params``
+        — runs on the learner thread, so the donated state handle cannot
+        race a dispatch).  The learnhealth plane must then fire the
+        ``nonfinite`` alert and stop the fabric cleanly."""
+        return self.fire("poison_params") is not None
 
     def session_client_kill(self) -> bool:
         """One opportunity per load-gen client step burst: True = the

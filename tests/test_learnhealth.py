@@ -1,0 +1,584 @@
+"""Learning-health plane (telemetry/learnhealth.py, ISSUE 13).
+
+Coverage map:
+- the in-graph diagnostic bundle pinned against HOST-SIDE ORACLES: a
+  pure-numpy re-unroll of the mlp+LSTM network from a zero state for the
+  ΔQ divergence, numpy bucketize for the |TD|/IS histograms (exact
+  integer counts), numpy norms for the grad/update/param/target-lag
+  fields;
+- cadence gating (``lax.cond`` on the step counter) and the disarmed
+  program's unchanged arity;
+- per-dispatch HOST_TRANSFERS counts UNCHANGED with diagnostics armed
+  (the anakin fused loop — the strictest budget in the tree);
+- the NaN sentry end to end: poisoned params (chaos ``poison_params``)
+  → nonfinite alert row + degraded /healthz + a CLEAN training stop;
+- alerts.jsonl resume-append continuity across a stop→resume cycle;
+- monitor / alert-engine / data-health units (spike EWMA vs the
+  freeze interplay, ESS collapse, replay-ratio band, /alertz).
+"""
+import json
+import os
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import test_config as make_test_config
+from r2d2_tpu.envs import FakeAtariEnv
+from r2d2_tpu.learner.step import (
+    _gather_time,
+    _window_indices,
+    create_train_state,
+    loss_and_priorities,
+    make_optimizer,
+    make_train_step,
+)
+from r2d2_tpu.models.network import R2D2Network, create_network, init_params
+from r2d2_tpu.telemetry.learnhealth import (
+    DIAG_SCALARS,
+    DIAG_SIZE,
+    IS_WEIGHT_EDGES,
+    PRIO_EDGES,
+    TD_ABS_EDGES,
+    _SCALAR_IDX,
+    AlertEngine,
+    LearnHealthMonitor,
+    priority_health,
+    read_alerts,
+    replay_ratio,
+)
+from r2d2_tpu.telemetry.registry import MetricsRegistry
+from r2d2_tpu.train import train
+from r2d2_tpu.utils.batch import synthetic_batch
+
+A = 4
+
+
+def env_factory(cfg, seed):
+    return FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=seed,
+                        episode_len=32)
+
+
+def lh_config(**kw):
+    base = dict(learnhealth_interval=1)
+    base.update(kw)
+    return make_test_config(**base)
+
+
+def scalar(diag, name):
+    return float(np.asarray(diag)[_SCALAR_IDX[name]])
+
+
+# ---------------------------------------------------------------------------
+# the host-side numpy re-unroll oracle (mlp torso + scan LSTM + dueling
+# head — the exact op sequence of models/network.py in float32 numpy)
+# ---------------------------------------------------------------------------
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def numpy_unroll(cfg, params, obs, last_action, last_reward, hidden):
+    """q (B, T, A) float32 — the R2D2Network.unroll twin for the mlp
+    torso, computed entirely in numpy (float32 like the jitted path:
+    test_config pins compute_dtype='float32')."""
+    p = params["params"]
+    B, T = obs.shape[:2]
+    H = cfg.hidden_dim
+    x = obs.reshape(B * T, -1).astype(np.float32) / np.float32(255.0)
+    d0 = p["torso"]["Dense_0"]
+    x = np.maximum(x @ np.asarray(d0["kernel"]) + np.asarray(d0["bias"]),
+                   0.0)
+    feats = np.concatenate(
+        [x.reshape(B, T, H), np.asarray(last_action, np.float32),
+         np.asarray(last_reward, np.float32)[..., None]], axis=-1)
+    xs = feats
+    for i in range(cfg.lstm_layers):
+        lp = p[f"lstm_{i}"]
+        wi, wh = np.asarray(lp["wi"]), np.asarray(lp["wh"])
+        b = np.asarray(lp["b"])
+        x_proj = xs @ wi + b                       # (B, T, 4H)
+        h = np.asarray(hidden[:, 0, i], np.float32)
+        c = np.asarray(hidden[:, 1, i], np.float32)
+        outs = np.empty((B, T, H), np.float32)
+        for t in range(T):
+            gates = x_proj[:, t] + h @ wh
+            gi, gf, gg, go = np.split(gates, 4, axis=-1)
+            c = _sigmoid(gf) * c + _sigmoid(gi) * np.tanh(gg)
+            h = _sigmoid(go) * np.tanh(c)
+            outs[:, t] = h
+        xs = outs
+    flat = xs.reshape(B * T, H)
+
+    def dense(sub, x):
+        return x @ np.asarray(sub["kernel"]) + np.asarray(sub["bias"])
+
+    adv = dense(p["head"]["adv_out"],
+                np.maximum(dense(p["head"]["adv_hidden"], flat), 0.0))
+    val = dense(p["head"]["val_out"],
+                np.maximum(dense(p["head"]["val_hidden"], flat), 0.0))
+    q = val + adv - adv.mean(axis=-1, keepdims=True)
+    return q.reshape(B, T, -1).astype(np.float32)
+
+
+def np_bucketize(values, mask, edges):
+    """The registry _Histogram bucket rule (bisect_left) in numpy —
+    exact integer counts."""
+    idx = np.searchsorted(np.asarray(edges), np.ravel(values),
+                          side="left")
+    out = np.zeros(len(edges) + 1, np.int64)
+    np.add.at(out, idx, np.ravel(mask).astype(np.int64))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the ΔQ / histogram / norm oracles
+# ---------------------------------------------------------------------------
+
+def test_diag_matches_host_oracles():
+    """One armed step on a synthetic batch: every diagnostic field is
+    pinned against an independent host-side recomputation — the ΔQ
+    against BOTH a jax re-unroll twin (tight) and the pure-numpy
+    re-unroll oracle (f32 matmul tolerance), the histograms exactly."""
+    cfg = lh_config()
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(1))
+    state = create_train_state(cfg, params)
+    batch_np = synthetic_batch(cfg, A, np.random.default_rng(3))
+    batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+
+    step = make_train_step(cfg, net, learnhealth=True)
+    new_state, loss, priorities, diag = jax.jit(step)(state, batch)
+    diag = np.asarray(jax.device_get(diag))
+    assert diag.shape == (DIAG_SIZE,)
+    assert scalar(diag, "armed") == 1.0
+    assert scalar(diag, "loss") == pytest.approx(float(loss), rel=1e-6)
+    assert scalar(diag, "nonfinite") == 0.0
+
+    # --- ΔQ: stored-state vs zero-state re-unroll ---------------------
+    def unroll(hid):
+        q, _ = net.apply(params, batch["obs"], batch["last_action"],
+                         batch["last_reward"], hid,
+                         method=R2D2Network.unroll)
+        return np.asarray(q)
+
+    q_stored = unroll(batch["hidden"])
+    q_zero = unroll(jnp.zeros_like(batch["hidden"]))
+    idx_online, _, mask = jax.device_get(_window_indices(
+        cfg, batch["burn_in"], batch["learning"], batch["forward"]))
+    take = np.take_along_axis
+    dq = np.abs(take(q_stored, idx_online[:, :, None], 1)
+                - take(q_zero, idx_online[:, :, None], 1))
+    dq = np.where(mask[:, :, None], dq, 0.0)
+    want_mean = dq.sum() / max(1, mask.sum() * A)
+    want_max = dq.max()
+    assert want_max > 0   # stored hiddens are nonzero: real divergence
+    np.testing.assert_allclose(scalar(diag, "dq_mean"), want_mean,
+                               rtol=2e-5)
+    np.testing.assert_allclose(scalar(diag, "dq_max"), want_max,
+                               rtol=2e-5)
+
+    # the numpy re-unroll oracle: the diag's recompute path really is a
+    # from-zero-state unroll of the same network
+    q_zero_np = numpy_unroll(cfg, jax.device_get(params), batch_np["obs"],
+                             batch_np["last_action"],
+                             batch_np["last_reward"],
+                             np.zeros_like(batch_np["hidden"]))
+    np.testing.assert_allclose(q_zero_np, q_zero, atol=5e-5, rtol=1e-4)
+    dq_np = np.abs(take(q_stored, idx_online[:, :, None], 1)
+                   - take(q_zero_np, idx_online[:, :, None], 1))
+    dq_np = np.where(mask[:, :, None], dq_np, 0.0)
+    np.testing.assert_allclose(scalar(diag, "dq_mean"),
+                               dq_np.sum() / max(1, mask.sum() * A),
+                               rtol=1e-3, atol=2e-5)
+
+    # --- |TD| + IS-weight histograms: exact integer counts ------------
+    (loss2, (prios2, aux)) = loss_and_priorities(
+        cfg, net, params, state.target_params, batch, with_aux=True)
+    td, mask2, _, max_abs_q = jax.device_get(aux)
+    n = len(DIAG_SCALARS)
+    td_counts = diag[n:n + len(TD_ABS_EDGES) + 1].astype(np.int64)
+    np.testing.assert_array_equal(
+        td_counts, np_bucketize(np.abs(td), mask2, TD_ABS_EDGES))
+    is_counts = diag[n + len(TD_ABS_EDGES) + 1:].astype(np.int64)
+    np.testing.assert_array_equal(
+        is_counts, np_bucketize(batch_np["is_weights"],
+                                np.ones(cfg.batch_size), IS_WEIGHT_EDGES))
+    assert td_counts.sum() == mask2.sum()
+    assert is_counts.sum() == cfg.batch_size
+    np.testing.assert_allclose(
+        scalar(diag, "td_abs_sum"),
+        np.where(mask2, np.abs(td), 0.0).sum(), rtol=1e-5)
+    np.testing.assert_allclose(scalar(diag, "max_abs_q"),
+                               np.abs(q_stored).max(), rtol=1e-6)
+
+    # --- norms: independent numpy recomputation -----------------------
+    grad_fn = jax.value_and_grad(
+        lambda p: loss_and_priorities(cfg, net, p, state.target_params,
+                                      batch), has_aux=True)
+    (_, _), grads = grad_fn(state.params)
+    opt = make_optimizer(cfg)
+    updates, _ = opt.update(grads, state.opt_state, state.params)
+
+    def np_norm(tree):
+        return np.sqrt(sum(
+            float(np.square(np.asarray(leaf, np.float64)).sum())
+            for leaf in jax.tree.leaves(jax.device_get(tree))))
+
+    np.testing.assert_allclose(scalar(diag, "grad_norm"), np_norm(grads),
+                               rtol=1e-5)
+    np.testing.assert_allclose(scalar(diag, "update_norm"),
+                               np_norm(updates), rtol=1e-5)
+    np.testing.assert_allclose(scalar(diag, "param_norm"),
+                               np_norm(new_state.params), rtol=1e-5)
+    lag = jax.tree.map(lambda p, t: p - t, new_state.params,
+                       new_state.target_params)
+    np.testing.assert_allclose(scalar(diag, "target_lag"), np_norm(lag),
+                               rtol=1e-4, atol=1e-7)
+
+
+def test_diag_cadence_gating_and_disarmed_arity():
+    """``lax.cond`` gating: armed exactly on multiples of the interval
+    (the step counter advances in-graph); interval=0 compiles the
+    3-tuple pre-learnhealth program — no diag output exists at all."""
+    cfg = lh_config(learnhealth_interval=3)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    state = create_train_state(cfg, params)
+    batch = {k: jnp.asarray(v)
+             for k, v in synthetic_batch(cfg, A,
+                                         np.random.default_rng(0)).items()}
+    step = jax.jit(make_train_step(cfg, net, learnhealth=True))
+    armed = []
+    for _ in range(6):
+        state, loss, prios, diag = step(state, batch)
+        armed.append(scalar(diag, "armed"))
+    assert armed == [0.0, 0.0, 1.0, 0.0, 0.0, 1.0]
+
+    cfg0 = lh_config(learnhealth_interval=0)
+    step0 = jax.jit(make_train_step(cfg0, create_network(cfg0, A),
+                                    learnhealth=True))
+    out = step0(create_train_state(cfg0, params), batch)
+    assert len(out) == 3   # disarmed == the pre-learnhealth signature
+
+
+def test_nan_sentry_counts_in_graph():
+    """A poisoned batch (NaN n-step reward) must light the in-graph
+    sentry: nonfinite > 0 in the armed diag."""
+    cfg = lh_config()
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    state = create_train_state(cfg, params)
+    b = synthetic_batch(cfg, A, np.random.default_rng(0))
+    b["n_step_reward"][0, 0] = np.nan
+    batch = {k: jnp.asarray(v) for k, v in b.items()}
+    _, loss, _, diag = jax.jit(make_train_step(cfg, net,
+                                               learnhealth=True))(state,
+                                                                  batch)
+    assert not np.isfinite(float(loss))
+    assert scalar(diag, "nonfinite") > 0
+
+
+# ---------------------------------------------------------------------------
+# monitor / engine / data-health units
+# ---------------------------------------------------------------------------
+
+def test_monitor_spike_ewma_and_freeze_interplay():
+    """The loss-spike rule advances ONLY on loss samples: a stall/freeze
+    produces no samples and therefore can never false-positive, while a
+    genuine spike past factor×EWMA is counted once per spiking sample."""
+    cfg = make_test_config(alert_loss_spike_factor=5.0)
+    eng = AlertEngine(cfg, MetricsRegistry())
+    mon = LearnHealthMonitor(cfg, engine=eng)
+    mon.note_losses(np.full(30, 0.1))          # warmup, no spikes
+    assert mon.snapshot()["loss_spikes"] == 0
+    # a freeze = NO samples for a long wall-clock stretch: nothing to do
+    mon.note_losses(np.full(5, 0.11))
+    assert mon.snapshot()["loss_spikes"] == 0
+    mon.note_losses(np.asarray([5.0]))         # 50x the EWMA
+    snap = mon.snapshot()
+    assert snap["loss_spikes"] == 1
+    eng.evaluate(dict(learnhealth=snap))
+    assert eng.counts().get("loss_spike") == 1
+    # re-evaluating the same snapshot is idempotent (delta rule)
+    eng.evaluate(dict(learnhealth=mon.snapshot()))
+    assert eng.counts().get("loss_spike") == 1
+
+
+def test_monitor_nonfinite_trips_and_fires_immediately():
+    cfg = make_test_config()
+    reg = MetricsRegistry()
+    eng = AlertEngine(cfg, reg)
+    mon = LearnHealthMonitor(cfg, engine=eng)
+    assert not mon.tripped
+    mon.note_losses(np.asarray([0.5, np.nan]))
+    assert mon.tripped
+    # fired at trip time, without any log-loop evaluate
+    assert eng.counts()["nonfinite"] == 1
+    assert eng.nonfinite_active
+    assert reg.get_counter("learnhealth.alert", rule="nonfinite") == 1
+
+
+def test_alert_engine_edge_rules_and_alertz(tmp_path):
+    """ess_collapse / replay_ratio / dq_drift are EDGE rules (fire on
+    the transition into violation); rows land in alerts.jsonl and the
+    /alertz payload carries rules+counts+recent."""
+    cfg = make_test_config(alert_ess_min=0.2, alert_replay_ratio_min=0.5,
+                           alert_replay_ratio_max=2.0, alert_dq_budget=1.0)
+    eng = AlertEngine(cfg, MetricsRegistry(), log_dir=str(tmp_path))
+    healthy = dict(
+        learnhealth=dict(nonfinite=0, loss_spikes=0, dq_mean=0.2),
+        replay=dict(replay_ratio=1.0,
+                    priorities=dict(ess_frac=0.9,
+                                    positive_leaves=4 * cfg.batch_size)),
+        training_steps=100)
+    assert eng.evaluate(healthy) == []
+    sick = dict(
+        learnhealth=dict(nonfinite=0, loss_spikes=0, dq_mean=3.0),
+        replay=dict(replay_ratio=7.0,
+                    priorities=dict(ess_frac=0.01,
+                                    positive_leaves=4 * cfg.batch_size)),
+        training_steps=200)
+    fired = {r["rule"] for r in eng.evaluate(sick)}
+    assert fired == {"dq_drift", "ess_collapse", "replay_ratio"}
+    # edge semantics: still in violation → no re-fire
+    assert eng.evaluate(sick) == []
+    assert set(eng.active()) == fired
+    # recovery then relapse → one more fire each
+    eng.evaluate(healthy)
+    assert eng.active() == []
+    assert {r["rule"] for r in eng.evaluate(sick)} == fired
+    eng.close()
+
+    rows = [json.loads(line) for line in
+            (tmp_path / "alerts.jsonl").read_text().splitlines()]
+    assert len(rows) == 6 and all(r["kind"] == "alert" for r in rows)
+    assert all(r["threshold"] is not None for r in rows)
+
+    status = eng.status()
+    assert status["counts"] == {"dq_drift": 2, "ess_collapse": 2,
+                                "replay_ratio": 2}
+    assert {r["rule"] for r in status["rules"]} >= fired | {
+        "nonfinite", "loss_spike"}
+    assert len(status["recent"]) == 6
+
+    # the exporter route contract: GET /alertz answers the status JSON
+    from r2d2_tpu.telemetry.exporter import TelemetryExporter
+
+    exp = TelemetryExporter(MetricsRegistry(), lambda: dict(ok=True),
+                            routes={"/alertz": eng.route}, port=0)
+    import threading
+
+    t = threading.Thread(target=exp.handle_once, daemon=True)
+    t.start()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/alertz", timeout=5) as resp:
+        payload = json.loads(resp.read().decode())
+    t.join(5)
+    exp.close()
+    assert payload["counts"]["replay_ratio"] == 2
+
+
+def test_priority_health_oracle():
+    leaves = np.asarray([0.0, 0.5, 0.5, 2.0, 0.0, 0.002])
+    ph = priority_health(leaves)
+    pos = leaves[leaves > 0]
+    want_ess = pos.sum() ** 2 / np.square(pos).sum()
+    assert ph["ess"] == pytest.approx(want_ess)
+    assert ph["ess_frac"] == pytest.approx(want_ess / 4)
+    assert ph["positive_leaves"] == 4
+    assert sum(ph["hist"]) == 4
+    np.testing.assert_array_equal(
+        ph["hist"], np_bucketize(pos, np.ones_like(pos), PRIO_EDGES))
+    empty = priority_health(np.zeros(8))
+    assert empty["positive_leaves"] == 0 and empty["ess_frac"] == 1.0
+
+
+def test_replay_buffer_data_health_and_member_fractions():
+    """ESS/histogram over the live sum tree, the replay-ratio gauge, and
+    per-member sampled-row counts riding the member_id stamp."""
+    from r2d2_tpu.replay.block import LocalBuffer
+    from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+
+    cfg = make_test_config(learning_starts=16)
+    buf = ReplayBuffer(cfg, A, rng=np.random.default_rng(0))
+    env = FakeAtariEnv(obs_shape=cfg.obs_shape, action_dim=A, seed=0,
+                       episode_len=32)
+    rng = np.random.default_rng(1)
+    hidden = np.zeros((2, cfg.lstm_layers, cfg.hidden_dim), np.float32)
+    member = 0
+    while not buf.ready:
+        lb = LocalBuffer(cfg, A)
+        obs, _ = env.reset()
+        lb.reset(obs)
+        for _ in range(cfg.block_length):
+            a = int(rng.integers(A))
+            obs, r, _, _, _ = env.step(a)
+            lb.add(a, float(r), obs,
+                   rng.random(A).astype(np.float32), hidden)
+        block, prios, _ = lb.finish(np.zeros(A, np.float32))
+        block.member_id = member
+        member = (member + 1) % 2
+        buf.add(block, prios, None)
+    dh = buf.data_health()
+    assert dh["priorities"]["positive_leaves"] > 0
+    assert 0 < dh["priorities"]["ess_frac"] <= 1.0
+    assert sum(dh["priorities"]["hist"]) == \
+        dh["priorities"]["positive_leaves"]
+    assert dh["replay_ratio"] == 0.0      # nothing trained yet
+
+    for _ in range(3):
+        batch = buf.sample_batch(cfg.batch_size)
+        buf.update_priorities(batch["idxes"],
+                              np.ones(cfg.batch_size),
+                              batch["block_ptr"], 0.1)
+    dh = buf.data_health()
+    spm = dh["samples_per_member"]
+    assert sum(spm.values()) == 3 * cfg.batch_size
+    assert set(spm) == {0, 1}             # both members actually sampled
+    assert dh["replay_ratio"] == pytest.approx(replay_ratio(
+        cfg, 3, buf.env_steps))
+
+
+# ---------------------------------------------------------------------------
+# HOST_TRANSFERS unchanged with diagnostics armed (the anakin budget)
+# ---------------------------------------------------------------------------
+
+def test_anakin_host_transfers_unchanged_with_diagnostics_armed():
+    """The fused loop's crossing budget — ONE result fetch per dispatch
+    — must hold with the learnhealth bundle armed (it rides the same
+    flat vector), and the armed diag rows must actually reach the
+    monitor."""
+    from r2d2_tpu.learner.anakin import AnakinPlane
+    from r2d2_tpu.learner.learner import Learner
+    from r2d2_tpu.replay.device_ring import DeviceRing
+    from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
+
+    cfg = make_test_config(
+        game_name="Fake", actor_transport="anakin", device_replay=True,
+        in_graph_per=True, num_actors=2, superstep_k=2,
+        anakin_episode_len=12, training_steps=10 ** 9,
+        learning_starts=16, learnhealth_interval=2)
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    state = create_train_state(cfg, params)
+    plane = AnakinPlane(cfg, net, A, DeviceRing(cfg, A))
+    plane.monitor = LearnHealthMonitor(cfg)
+    learner = Learner(cfg, net, state)
+    while not plane.ready:
+        plane.rollout_step(learner.state.params)
+
+    before = HOST_TRANSFERS.get("anakin.result_fetch")
+    dispatches = 5
+    for _ in range(dispatches):
+        learner.state, flat = plane.dispatch(learner.state)
+        plane.harvest(flat)
+    assert HOST_TRANSFERS.get("anakin.result_fetch") - before \
+        == dispatches
+    snap = plane.monitor.snapshot()
+    # k=2, interval=2: one armed inner step per dispatch
+    assert snap["armed_steps"] == dispatches
+    assert snap["loss_count"] == dispatches * cfg.superstep_k
+    assert snap["nonfinite"] == 0 and snap["dq_mean"] >= 0
+    assert sum(snap["td_hist"]) > 0
+    RETRACES.assert_within_budgets()
+
+
+# ---------------------------------------------------------------------------
+# e2e: NaN sentry, alerts.jsonl resume continuity
+# ---------------------------------------------------------------------------
+
+def test_nan_sentry_e2e_poisoned_params(tmp_path):
+    """chaos ``poison_params`` mid-run: the run must fire the nonfinite
+    alert (durable row + counter), flip /healthz to degraded, and stop
+    CLEANLY (drain-then-save, no fabric failure / crashed thread)."""
+    cfg = make_test_config(
+        game_name="Fake", training_steps=10 ** 6, log_interval=0.2,
+        learnhealth_interval=1, chaos_spec="poison_params:at=20")
+    m = train(cfg, env_factory=env_factory, checkpoint_dir=str(tmp_path),
+              verbose=False, max_wall_seconds=120)
+    assert m["num_updates"] < 10 ** 6      # the trip stopped training
+    assert not m["fabric_failed"]          # ... cleanly
+    assert m["alerts"].get("nonfinite", 0) >= 1
+    assert m["learnhealth"]["nonfinite"] > 0
+    assert m["healthz"]["status"] == "degraded"
+    assert m["healthz"]["degraded"] is True
+    rows = read_alerts(str(tmp_path))
+    assert any(r["rule"] == "nonfinite" for r in rows)
+    # the drain-then-save epilogue still ran: a replay snapshot exists
+    from r2d2_tpu.checkpoint import Checkpointer
+
+    assert Checkpointer(str(tmp_path)).replay_steps()
+
+
+def test_alerts_jsonl_resume_append_continuity(tmp_path):
+    """A stop→resume cycle must APPEND to the same alerts.jsonl (RunLog
+    conventions — the preemption story of every durable record): round
+    2's rows land after round 1's, which stay byte-identical."""
+    # a replay-ratio band the very first trained interval violates →
+    # one deterministic fire per run
+    cfg = make_test_config(
+        game_name="Fake", training_steps=20, log_interval=0.2,
+        learnhealth_interval=2, alert_replay_ratio_min=0.0,
+        alert_replay_ratio_max=1e-6)
+    m1 = train(cfg, env_factory=env_factory, checkpoint_dir=str(tmp_path),
+               verbose=False, max_wall_seconds=120)
+    assert m1["alerts"].get("replay_ratio", 0) >= 1
+    path = tmp_path / "telemetry" / "alerts.jsonl"
+    round1 = path.read_text()
+    rows1 = read_alerts(str(tmp_path))
+    assert rows1
+
+    m2 = train(cfg.replace(training_steps=40), env_factory=env_factory,
+               checkpoint_dir=str(tmp_path), resume=True, verbose=False,
+               max_wall_seconds=120)
+    assert m2["alerts"].get("replay_ratio", 0) >= 1
+    content = path.read_text()
+    assert content.startswith(round1)      # append-only continuity
+    rows2 = read_alerts(str(tmp_path))
+    assert len(rows2) > len(rows1)
+
+
+def test_train_e2e_diagnostics_and_no_false_alerts(tmp_path):
+    """A healthy threaded run with every rule armed (wide thresholds):
+    diagnostics flow (armed steps, ΔQ, histograms, replay health on the
+    entries and the registry) and ZERO alerts fire."""
+    cfg = make_test_config(
+        game_name="Fake", training_steps=30, log_interval=0.2,
+        learnhealth_interval=2, alert_ess_min=0.001,
+        alert_replay_ratio_max=1e6, alert_dq_budget=1e6,
+        telemetry_port=-1)
+    m = train(cfg, env_factory=env_factory, checkpoint_dir=str(tmp_path),
+              verbose=False, max_wall_seconds=120)
+    assert m["num_updates"] >= 30
+    assert m["alerts"] == {}
+    lh = m["learnhealth"]
+    assert lh["armed_steps"] >= m["num_updates"] // 2 - 1
+    assert lh["dq_mean"] > 0 and lh["grad_norm"] > 0
+    assert sum(lh["td_hist"]) > 0 and sum(lh["is_hist"]) > 0
+    entries = [e for e in m["logs"] if e.get("learnhealth")]
+    assert entries
+    last = entries[-1]
+    assert last["alerts"] == {}
+    assert last["replay_health"]["priorities"]["positive_leaves"] > 0
+    assert read_alerts(str(tmp_path)) == []
+    # the console line renders the ΔQ diagnostic
+    from r2d2_tpu.telemetry import format_entry
+
+    assert "dq=" in format_entry(last)
+    # registry absorption: gauges + the declared histograms landed
+    reg = None  # metrics carry no registry; assert via a fresh record
+    from r2d2_tpu.telemetry.plane import Telemetry
+
+    tel = Telemetry(cfg)
+    tel.record(last)
+    reg = tel.registry
+    assert reg.get_gauge("learnhealth.dq_mean") > 0
+    assert reg.get_counter("learnhealth.armed_steps") > 0
+    snap = reg.snapshot()
+    assert "learnhealth.td_abs" in snap["histograms"]
+    assert "learnhealth.is_weight" in snap["histograms"]
+    assert any(k.startswith("learnhealth.replay.ess")
+               for k in snap["gauges"])

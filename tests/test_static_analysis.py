@@ -629,6 +629,37 @@ def test_telemetry_discipline_covers_tracing_api():
     assert report.findings == []
 
 
+def test_telemetry_discipline_alert_rule_vocabulary():
+    """The learnhealth alert-rule vocabulary (telemetry/learnhealth.py):
+    rule names must be string literals (AlertRule construction AND
+    engine .fire calls) and AlertRule thresholds must come from cfg —
+    an inline magic number in a rule body is a finding."""
+    report = analyze_source(_src("""
+        def build(cfg, engine, kind):
+            rules = [
+                AlertRule(f"rule_{kind}", check=chk),
+                AlertRule("loss_spike", check=chk, threshold=10.0),
+                learnhealth.AlertRule(name_var, check=chk),
+            ]
+            engine.fire(f"alert_{kind}")
+            self.alert_engine.fire(kind)
+    """), rules=["telemetry-discipline"])
+    assert len(report.findings) == 5
+    assert sum("magic number" in f.message for f in report.findings) == 1
+    report = analyze_source(_src("""
+        def build(cfg, engine):
+            rules = [
+                AlertRule("nonfinite", check=chk),
+                AlertRule("dq_drift", check=chk,
+                          threshold=cfg.alert_dq_budget),
+                AlertRule("replay_ratio", check=chk, threshold=None),
+            ]
+            engine.fire("nonfinite", value=1.0)
+            queue.fire(f"not_an_{engine_like}")   # not an engine shape
+    """), rules=["telemetry-discipline"])
+    assert report.findings == []
+
+
 def test_telemetry_discipline_suppressed_with_reason():
     report = analyze_source(_src("""
         def absorb(registry, mapping, prefix):

@@ -354,6 +354,17 @@ def main() -> int:
         game_name="Fake", training_steps=10 ** 9, log_interval=1.0,
         save_interval=200, keep_checkpoints=3, chaos_spec=chaos,
         learner_stall_timeout=30.0, replay_snapshot_interval=5.0,
+        # learnhealth plane armed as a STANDING SOAK INVARIANT: the
+        # in-graph diagnostics run every 8 steps and every alert rule is
+        # armed (wide/neutral thresholds) — a round that fires ANY
+        # learnhealth.alert fails below.  The default chaos spec keeps
+        # freeze_learner in every round, so this also pins the
+        # loss-spike/stall interplay: a frozen learner produces NO new
+        # loss samples, the spike EWMA only advances on samples, and a
+        # freeze must therefore never false-positive a loss_spike.
+        learnhealth_interval=8, alert_ess_min=0.005,
+        alert_replay_ratio_min=0.0, alert_replay_ratio_max=1e6,
+        alert_dq_budget=1e6,
         seed=int(time.time()) & 0xFFFF, **transport, **extra)
 
     deadline = time.time() + MINUTES * 60
@@ -450,8 +461,20 @@ def main() -> int:
                         failures.append(
                             f"round {rnd}: {dups} duplicate league "
                             "rows (cursor resume broke)")
+                rec["alerts"] = m.get("alerts") or {}
                 rounds.append(rec)
                 print(json.dumps(rec), flush=True)
+
+                # learnhealth standing invariant: chaos drills exercise
+                # RECOVERY paths, none of which may look like a learning
+                # pathology — zero unexpected alert fires per round
+                # (incl. the freeze_learner rounds: a stall must not
+                # false-positive the loss-spike rule)
+                fired = {k: v for k, v in rec["alerts"].items() if v}
+                if fired:
+                    failures.append(
+                        f"round {rnd}: unexpected learnhealth alerts "
+                        f"{fired}")
 
                 # invariants a chaos round must uphold.  (num_updates may
                 # legitimately regress across rounds: a truncated final

@@ -104,6 +104,34 @@ def render(entry, health=None) -> str:
                 + (f"best={best:.1f}@{row.get('best_step', -1)} "
                    if best is not None else "")
                 + f"evals={row.get('evals', 0)}")
+    lh = entry.get("learnhealth") or {}
+    if lh.get("armed_steps"):
+        # newest armed in-graph diagnostics (telemetry/learnhealth.py)
+        lines.append(
+            "  learnhealth: "
+            f"dq={lh.get('dq_mean', float('nan')):.4f}"
+            f"/{lh.get('dq_max', float('nan')):.4f} "
+            f"grad_norm={lh.get('grad_norm', float('nan')):.3g} "
+            f"target_lag={lh.get('target_lag', float('nan')):.3g} "
+            f"max|Q|={lh.get('max_abs_q', float('nan')):.3g} "
+            f"armed={lh.get('armed_steps', 0)}")
+    rh = entry.get("replay_health") or {}
+    prio_rows = (rh.get("shards")
+                 if rh.get("shards") is not None
+                 else [dict(rh["priorities"], shard=None)]
+                 if rh.get("priorities") else [])
+    for row in prio_rows:
+        tag = ("" if row.get("shard") is None
+               else f" shard{row['shard']}")
+        lines.append(
+            f"  replay{tag}: ess={row.get('ess', 0.0):.1f} "
+            f"({100.0 * row.get('ess_frac', 0.0):.1f}% of "
+            f"{row.get('positive_leaves', 0)} leaves) "
+            f"ratio={rh.get('replay_ratio', 0.0):.2f}")
+    alerts = {k: v for k, v in (entry.get("alerts") or {}).items() if v}
+    if alerts:
+        lines.append("  ** ALERTS ** " + " ".join(
+            f"{k}={v}" for k, v in sorted(alerts.items())))
     chaos = entry.get("chaos")
     if chaos:
         lines.append("  chaos: " + " ".join(f"{k}={v}"
