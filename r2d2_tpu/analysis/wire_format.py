@@ -53,6 +53,22 @@ SESSION_WIRE_MODULE_SUFFIX = "serving/wire.py"
 SESSION_WIRE_NAMES = {"session_request_spec", "session_response_spec",
                       "encode_frame", "decode_frame", "peek_kind",
                       "FrameReader"}
+# the cross-host replay fabric's RPC vocabulary: the net frame specs and
+# message kinds are canonical in replay/netwire.py (themselves DERIVED
+# from replay/block.py's slot specs and framed by serving/wire.py's
+# grammar — one CRC definition all the way down); a transport module
+# restating a spec or a kind constant is exactly the drift that makes a
+# shard and a trainer mis-frame each other's traffic
+NET_WIRE_MODULE = "r2d2_tpu.replay.netwire"
+NET_WIRE_MODULE_SUFFIX = "replay/netwire.py"
+NET_WIRE_NAMES = {"net_hello_spec", "net_ingest_spec",
+                  "net_sample_response_spec", "net_feedback_spec",
+                  "net_stats_spec", "net_save_spec",
+                  "net_save_response_spec", "layout_token",
+                  "max_net_frame_bytes", "ingest_shape_header",
+                  "NMSG_HELLO", "NMSG_WELCOME", "NMSG_INGEST",
+                  "NMSG_SAMPLE_REQ", "NMSG_SAMPLE_RSP", "NMSG_PRIO",
+                  "NMSG_STATS", "NMSG_SAVE", "NMSG_SAVE_RSP"}
 CRC_MASK_VALUE = 0xFFFFFFFF
 
 
@@ -98,10 +114,12 @@ def _imports_from(tree: ast.AST, module: str) -> Set[str]:
 
 
 # (canonical module, its path suffix, its vocabulary) — the replay slab
-# conventions and the session socket conventions, checked identically
+# conventions, the session socket conventions and the cross-host replay
+# RPC conventions, checked identically
 _VOCABULARIES = (
     (WIRE_MODULE, WIRE_MODULE_SUFFIX, WIRE_NAMES),
     (SESSION_WIRE_MODULE, SESSION_WIRE_MODULE_SUFFIX, SESSION_WIRE_NAMES),
+    (NET_WIRE_MODULE, NET_WIRE_MODULE_SUFFIX, NET_WIRE_NAMES),
 )
 
 
@@ -142,6 +160,15 @@ def check_wire_format(ctx: Context) -> List[Finding]:
                     findings.append(Finding(
                         RULE, mod.rel, node.lineno,
                         f"wire-format {node.name!r} re-defined here — "
+                        f"import it from {module}"))
+                elif (isinstance(node, ast.Name)
+                      and isinstance(node.ctx, ast.Store)
+                      and node.id in names):
+                    # a constant restated (e.g. a NMSG_* kind literal):
+                    # the same drift as a re-defined function
+                    findings.append(Finding(
+                        RULE, mod.rel, node.lineno,
+                        f"wire-format {node.id!r} re-defined here — "
                         f"import it from {module}"))
                 elif (isinstance(node, ast.Name)
                       and isinstance(node.ctx, ast.Load)

@@ -203,6 +203,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "RPC deadline is cfg.replay_sample_timeout "
                          "(--set replay_sample_timeout=SECS); overrides "
                          "cfg.replay_shards")
+    pt.add_argument("--replay-transport", choices=("shm", "socket"),
+                    default=None,
+                    help="how the sharded replay plane's RPCs travel: "
+                         "'shm' (same-host owner processes, the fast "
+                         "path; default) or 'socket' (length-framed "
+                         "CRC'd TCP — the cross-host replay fabric, "
+                         "parallel/replay_net.py; with no --replay-hosts "
+                         "the plane spawns loopback shard servers "
+                         "itself); overrides cfg.replay_transport")
+    pt.add_argument("--replay-hosts", default=None, metavar="HOSTS",
+                    help="socket replay transport: comma-separated "
+                         "host:port endpoints of running `r2d2_tpu "
+                         "replay-shard` servers, one per replay shard "
+                         "(implies --replay-transport socket); an "
+                         "unreachable shard's strata redistribute over "
+                         "the reachable mass and it re-attaches through "
+                         "the epoch handshake when it returns; overrides "
+                         "cfg.replay_hosts")
     pt.add_argument("--mesh", action="store_true",
                     help="GSPMD learner over all visible devices: one "
                          "table-driven pjit train step on the dp x fsdp x "
@@ -261,6 +279,42 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "none exists yet")
     pv.add_argument("--max-wall-seconds", type=float, default=None)
     pv.add_argument("--quiet", action="store_true")
+
+    pp = sub.add_parser(
+        "replay-shard",
+        help="run ONE cross-host replay shard server (the socket "
+             "replay fabric's remote end, parallel/replay_net.py)")
+    _add_common(pp)
+    pp.add_argument("--port", type=int, required=True, metavar="PORT",
+                    help="listen port on --host (0 = ephemeral, printed "
+                         "at start).  The trainer names it in "
+                         "--replay-hosts")
+    pp.add_argument("--host", default="127.0.0.1",
+                    help="listen address (default loopback; bind a "
+                         "routable address for a genuinely remote "
+                         "trainer — no TLS/auth yet, keep it on a "
+                         "trusted network, docs/OPERATIONS.md)")
+    pp.add_argument("--shard-id", type=int, default=0, metavar="S",
+                    help="which of the trainer's replay_shards slices "
+                         "this server owns (0-based; the trainer's "
+                         "HELLO names the shard it expects)")
+    pp.add_argument("--replay-shards", type=int, default=None,
+                    metavar="K",
+                    help="total shard count K (must match the "
+                         "trainer's --replay-shards: the slice geometry "
+                         "is derived from it); overrides "
+                         "cfg.replay_shards")
+    pp.add_argument("--action-dim", type=int, default=None, metavar="A",
+                    help="the policy's action count; default creates "
+                         "the configured env once to read it")
+    pp.add_argument("--epoch", type=int, default=None, metavar="N",
+                    help="incarnation tag stamped into every frame "
+                         "(default: a boot-time stamp — every restart "
+                         "is a new epoch, so stale feedback from a "
+                         "previous incarnation is droppable on the "
+                         "wire)")
+    pp.add_argument("--max-wall-seconds", type=float, default=None)
+    pp.add_argument("--quiet", action="store_true")
 
     pe = sub.add_parser("eval", help="checkpoint sweep -> learning curve")
     _add_common(pe)
@@ -321,6 +375,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     act_response_timeout=args.act_response_timeout)
             if args.replay_shards is not None:
                 cfg = cfg.replace(replay_shards=args.replay_shards)
+            if args.replay_hosts is not None:
+                # naming hosts implies the socket transport
+                cfg = cfg.replace(replay_transport="socket",
+                                  replay_hosts=args.replay_hosts)
+            if args.replay_transport is not None:
+                cfg = cfg.replace(replay_transport=args.replay_transport)
             if args.sharding_table is not None:
                 cfg = cfg.replace(sharding_table=args.sharding_table)
             if args.population is not None:
@@ -393,6 +453,38 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_wall_seconds_per_game=args.max_wall_seconds_per_game,
             use_mesh=args.mesh, verbose=not args.quiet)
         print(json.dumps({g: s["final_reward"] for g, s in summary.items()}))
+        return 0
+
+    if args.cmd == "replay-shard":
+        try:
+            if args.replay_shards is not None:
+                cfg = cfg.replace(replay_shards=args.replay_shards)
+            if not 0 <= args.shard_id < cfg.replay_shards:
+                raise ValueError(
+                    f"--shard-id {args.shard_id} is outside "
+                    f"[0, {cfg.replay_shards}) — it names which of the "
+                    "trainer's replay_shards slices this server owns")
+        except ValueError as e:
+            parser.error(str(e))
+        action_dim = args.action_dim
+        if action_dim is None:
+            from r2d2_tpu.envs import create_env
+
+            probe = create_env(cfg, noop_start=False, seed=cfg.seed)
+            action_dim = probe.action_space.n
+            try:
+                probe.close()
+            except Exception:
+                pass
+        from r2d2_tpu.parallel.replay_net import run_shard_server
+
+        summary = run_shard_server(
+            cfg, action_dim, shard_id=args.shard_id, host=args.host,
+            port=args.port, epoch=args.epoch,
+            max_wall_seconds=args.max_wall_seconds,
+            verbose=not args.quiet)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if isinstance(v, (int, float, str))}))
         return 0
 
     if args.cmd == "eval":

@@ -526,6 +526,42 @@ class Config:
                                       # healthy shards' mass (the learner
                                       # never stalls on a dead or stalled
                                       # shard); must be > 0
+    replay_transport: str = "shm"     # how the sharded replay plane's
+                                      # RPCs travel: "shm" (same-host
+                                      # owner processes over preallocated
+                                      # shared-memory slabs — the fast
+                                      # path, parallel/replay_shards.py)
+                                      # or "socket" (length-framed CRC'd
+                                      # TCP frames, replay/netwire.py +
+                                      # parallel/replay_net.py — the
+                                      # cross-host fabric; with no
+                                      # replay_hosts the plane spawns
+                                      # loopback shard servers itself,
+                                      # keeping the whole wire path
+                                      # tier-1-testable)
+    replay_hosts: str = ""            # socket transport only: comma-
+                                      # separated "host:port" endpoints,
+                                      # one per replay shard, of already-
+                                      # running `r2d2_tpu replay-shard`
+                                      # servers.  Empty = managed
+                                      # loopback (the plane spawns local
+                                      # shard servers on ephemeral
+                                      # 127.0.0.1 ports).  Remote shards
+                                      # are re-attached through the epoch
+                                      # handshake on reconnect, never
+                                      # respawned from here
+    replay_net_cooldown: float = 2.0  # socket transport: per-shard-link
+                                      # circuit-breaker cooldown — while
+                                      # a link's circuit is open its mass
+                                      # leaves the gossiped view and its
+                                      # strata redistribute; one probe
+                                      # RPC per cooldown re-closes it
+                                      # (utils/resilience.py); must be >0
+    replay_net_send_budget: float = 2.0  # socket transport: hard bound on
+                                      # one ingest frame send before the
+                                      # block is dropped-with-count — a
+                                      # partitioned shard must never
+                                      # wedge an actor sink; must be > 0
     device_replay: bool = False       # replay data lives in HBM; batches
                                       # are gathered in-graph (device_ring)
     device_ring_layout: str = "auto"  # "replicated" (full ring per device)
@@ -850,6 +886,46 @@ class Config:
                 "replay_sample_timeout must be > 0 (the sample RPC "
                 "deadline is what keeps a dead shard from wedging the "
                 "sample thread — there is no unbounded mode)")
+        if self.replay_transport not in ("shm", "socket"):
+            raise ValueError(
+                f"replay_transport must be 'shm' or 'socket', got "
+                f"{self.replay_transport!r}")
+        if self.replay_hosts and self.replay_transport != "socket":
+            raise ValueError(
+                "replay_hosts names remote replay-shard servers and only "
+                "means anything with replay_transport='socket'")
+        if self.replay_transport == "socket":
+            if self.device_replay:
+                raise ValueError(
+                    "replay_transport='socket' moves the HOST replay "
+                    "plane off-host; device_replay keeps replay in HBM — "
+                    "pick one")
+            if self.actor_transport == "anakin":
+                raise ValueError(
+                    "replay_transport='socket' is meaningless under the "
+                    "anakin transport (the fused loop keeps replay "
+                    "on-device)")
+            if self.num_blocks % self.replay_shards:
+                raise ValueError(
+                    f"num_blocks ({self.num_blocks}) must divide evenly "
+                    f"over replay_shards ({self.replay_shards}) so every "
+                    "shard owns an equal slot slice")
+            if self.replay_hosts:
+                hosts = parse_replay_hosts(self.replay_hosts)
+                if len(hosts) != self.replay_shards:
+                    raise ValueError(
+                        f"replay_hosts names {len(hosts)} endpoints but "
+                        f"replay_shards is {self.replay_shards} — one "
+                        "host:port per shard")
+        if self.replay_net_cooldown <= 0:
+            raise ValueError(
+                "replay_net_cooldown must be > 0 (the circuit cooldown "
+                "paces re-attach probes to a partitioned shard)")
+        if self.replay_net_send_budget <= 0:
+            raise ValueError(
+                "replay_net_send_budget must be > 0 (the bounded ingest "
+                "send is what keeps a partitioned shard from wedging an "
+                "actor sink — there is no unbounded mode)")
         if self.in_graph_per and not self.device_replay:
             raise ValueError("in_graph_per requires device_replay=True "
                              "(sampling reads the HBM-resident ring)")
@@ -985,6 +1061,30 @@ def _clamp_fleets(base: dict, kw: dict) -> dict:
     if "actor_fleets" not in kw:
         base["actor_fleets"] = min(base["actor_fleets"], base["num_actors"])
     return base
+
+def parse_replay_hosts(spec: str):
+    """``"host:port,host:port"`` → ``[(host, port), ...]``.  Raises
+    ValueError on a malformed entry (Config validation calls this so a
+    typo fails at construction, not at first connect)."""
+    out = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        host, sep, port = entry.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                f"replay_hosts entry {entry!r} is not 'host:port'")
+        try:
+            port_n = int(port)
+        except ValueError:
+            raise ValueError(
+                f"replay_hosts entry {entry!r} has a non-integer port")
+        if not 1 <= port_n <= 65535:
+            # 0 is never a valid connect target (the managed plane uses
+            # it internally as the not-yet-spawned sentinel)
+            raise ValueError(
+                f"replay_hosts entry {entry!r}: port out of range")
+        out.append((host, port_n))
+    return out
+
 
 def smoke_config(**kw) -> Config:
     """configs[0]: MsPacman, 1 actor, LSTM-512 CPU smoke."""

@@ -181,30 +181,43 @@ class FrameReader:
     zero on a quiet poll, several under pipelining.  Raises
     :class:`WireClosed` on EOF, so a reader loop stays a simple
     poll-with-timeout / check-stop cycle (the ``bounded-wait``
-    discipline)."""
+    discipline).
 
-    def __init__(self, sock: socket.socket):
+    ``max_frame`` is the desync sanity bound: session traffic keeps the
+    default; transports with bigger legitimate frames (the cross-host
+    replay fabric's preassembled batch responses, replay/netwire.py)
+    pass their layout-derived bound so the check stays tight."""
+
+    def __init__(self, sock: socket.socket,
+                 max_frame: int = MAX_FRAME_BYTES):
         self.sock = sock
+        self.max_frame = int(max_frame)
+        # bytes the LAST poll() recv'd (0 = quiet): drain loops use it
+        # to tell "socket idle" from "mid-frame, keep pulling" — a poll
+        # returns no frames in both cases
+        self.last_chunk = 0
         self._buf = bytearray()
 
     def poll(self) -> list:
         try:
             chunk = self.sock.recv(1 << 16)
         except socket.timeout:
+            self.last_chunk = 0
             return []
         except OSError:
             raise WireClosed("connection reset")
         if not chunk:
             raise WireClosed("peer closed")
+        self.last_chunk = len(chunk)
         self._buf.extend(chunk)
         out = []
         while True:
             if len(self._buf) < _LEN.size:
                 return out
             (n,) = _LEN.unpack_from(self._buf)
-            if n > MAX_FRAME_BYTES:
+            if n > self.max_frame:
                 raise WireGarbled(f"frame length {n} exceeds the "
-                                  f"{MAX_FRAME_BYTES}-byte bound — "
+                                  f"{self.max_frame}-byte bound — "
                                   "desynced stream")
             if len(self._buf) < _LEN.size + n:
                 return out

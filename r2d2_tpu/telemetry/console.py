@@ -47,6 +47,15 @@ def format_entry(entry: Dict[str, Any], prefix: str = "[r2d2]") -> str:
             line += f" shard_respawns={respawns}"
         if rs.get("sample_timeouts"):
             line += f" shard_timeouts={rs['sample_timeouts']}"
+        net = rs.get("net")
+        if net:
+            # cross-host transport: link connectivity at a glance, plus
+            # the partition-story counters when they are non-zero
+            line += f" net={net.get('connected', 0)}/{rs.get('shards', 0)}"
+            if net.get("reconnects"):
+                line += f" reconnects={net['reconnects']}"
+            if net.get("epoch_drops"):
+                line += f" epoch_drops={net['epoch_drops']}"
     if entry.get("corrupt_blocks"):
         line += f" corrupt_blocks={entry['corrupt_blocks']}"
     lh = entry.get("learnhealth") or {}

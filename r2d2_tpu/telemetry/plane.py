@@ -228,6 +228,21 @@ class Telemetry:
             for sh, n in enumerate(rs.get("per_shard_corrupt", [])):
                 reg.counter_max("replay.shard.shard_corrupt_blocks", n,
                                 shard=str(sh))
+            # cross-host transport (parallel/replay_net.py): the link
+            # table's aggregates — per-link circuit_state / connected /
+            # event counters are plane-written LIVE with labels, so only
+            # the unlabeled aggregates absorb here (the two-schema
+            # double-count rule above)
+            net = rs.get("net")
+            if net:
+                reg.set_gauge("replay.net.links_connected",
+                              net.get("connected", 0))
+                reg.counter_max("replay.net.shard_epoch_drops",
+                                net.get("shard_epoch_drops", 0))
+                reg.counter_max("replay.net.shard_garbled",
+                                net.get("shard_garbled", 0))
+                reg.counter_max("replay.net.prio_batches",
+                                net.get("prio_batches", 0))
         # shard-health drive-by on the base stats schema (zero on the
         # in-process path — replay.corrupt_blocks also covers the K=1
         # buffer's wire-format drops); shard_respawns stays entry/console

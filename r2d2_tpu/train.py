@@ -234,7 +234,19 @@ def _build(cfg: Config, env_factory: EnvFactory, use_mesh: bool,
                       start_env_steps=start_env_steps,
                       start_minutes=start_minutes, table=table)
     replay_plane = None
-    if cfg.replay_shards > 1:
+    if cfg.replay_transport == "socket":
+        # cross-host replay fabric (parallel/replay_net.py): the shard
+        # RPCs travel as length-framed CRC'd TCP messages, so the K
+        # shards may be remote `r2d2_tpu replay-shard` servers
+        # (cfg.replay_hosts) or plane-spawned loopback processes (the
+        # tier-1-testable default).  Same facade as the shm plane;
+        # config validation already rejected device_replay/anakin here.
+        from r2d2_tpu.parallel.replay_net import NetShardedReplayPlane
+
+        buffer = NetShardedReplayPlane(
+            cfg, action_dim, rng=np.random.default_rng(cfg.seed))
+        replay_plane = buffer
+    elif cfg.replay_shards > 1:
         # sharded replay plane (parallel/replay_shards.py): K owner
         # processes each run the ReplayBuffer core over their slot
         # slice; this coordinator facade fills the buffer role in the
@@ -1241,8 +1253,19 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
                                         alive=rh["alive"],
                                         respawns=rh["respawns"],
                                         failed=rh["failed"])
-            # a dead shard mid-respawn: the plane keeps serving from the
-            # survivors (redistributed strata) — degraded, not failing
+            if "net" in rh:
+                # socket transport: surface the per-link verdicts —
+                # connection, circuit state, reconnects, epoch drops —
+                # so a prober sees WHICH link is partitioned
+                out["replay_shards"]["net"] = dict(
+                    connected=rh["net"]["connected"],
+                    reconnects=rh["net"]["reconnects"],
+                    epoch_drops=rh["net"]["epoch_drops"],
+                    circuits=[row["circuit"]
+                              for row in rh["net"]["links"]])
+            # a dead/partitioned shard mid-heal: the plane keeps serving
+            # from the survivors (redistributed strata) — degraded, not
+            # failing
             degraded = degraded or bool(rh["degraded"])
         if sidecar is not None:
             lh = sidecar.health()

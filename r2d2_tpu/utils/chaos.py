@@ -86,6 +86,35 @@ recovery path the fabric claims to have can be exercised under load:
                       duplicate rows, no skipped members), training
                       throughput untouched; an exhausted respawn budget
                       degrades /healthz, never the fabric.
+- ``partition_shard_link`` — (socket replay, ``replay_transport=
+                      "socket"``) blackhole one shard link in BOTH
+                      directions for ``dur`` seconds, the socket left
+                      standing — a real partition.  The shard's gossip
+                      goes stale and its RPCs time out; its mass must
+                      leave the view, its strata redistribute over the
+                      reachable shards (zero learner stalls), blocks
+                      routed to it drop-with-count, and at the heal the
+                      link must re-attach with no stale response or
+                      feedback ever applied (epoch/seq guards).
+- ``delay_shard_link``    — (socket replay) one rtt spike: the link's
+                      receiver sleeps ``dur`` before its next dispatch.
+                      Below the RPC deadline it must only show up in
+                      the replay.net.rtt_s histogram; above it, it must
+                      behave exactly like a partition (bounded,
+                      redistributed, healed).
+- ``half_open_shard``     — (socket replay) the classic half-open peer:
+                      for ``dur`` seconds the trainer's sends are
+                      silently lost while receives still work.  Sample
+                      requests vanish → the deadline fires and rows
+                      redistribute; the circuit opens after repeated
+                      losses and the probe re-closes it at the heal —
+                      never a wedge, never a torn frame.
+- ``garble_net_frame``    — (socket replay) flip bytes in a received
+                      frame before decode; the frame CRC must catch
+                      every one (dropped + counted in
+                      replay.net.garbled) and a garbled sample response
+                      must be re-requested by the bounded retry — torn
+                      frames never reach the ring or the learner.
 
 Spec grammar — semicolon-separated ``kind[:key=val[,key=val...]]``::
 
@@ -122,7 +151,9 @@ _KINDS = ("kill_fleet", "garble_block", "truncate_ckpt", "freeze_learner",
           "freeze_service", "drop_act_response", "garble_act_response",
           "stall_pump", "wedge_dispatch", "kill_replay_shard",
           "garble_sample_response", "stall_shard", "kill_session_client",
-          "slow_session_client", "kill_eval_sidecar", "poison_params")
+          "slow_session_client", "kill_eval_sidecar", "poison_params",
+          "partition_shard_link", "delay_shard_link", "half_open_shard",
+          "garble_net_frame")
 
 
 def parse_spec(spec: str) -> Dict[str, Dict[str, float]]:
@@ -356,6 +387,35 @@ class ChaosInjector:
         the other sessions at full rate meanwhile."""
         prm = self.fire("slow_session_client")
         return float(prm.get("dur", 2.0)) if prm else 0.0
+
+    def net_partition_seconds(self) -> float:
+        """Seconds one replay shard link should be blackholed in both
+        directions (0.0 = no partition).  One opportunity per sample
+        request issued to a shard (traffic-aligned — ``at=``/``every=``
+        land under real sampling load); the fired link is the one the
+        request was headed for (parallel/replay_net.py)."""
+        prm = self.fire("partition_shard_link")
+        return float(prm.get("dur", 2.0)) if prm else 0.0
+
+    def net_delay_seconds(self) -> float:
+        """Seconds the link's receiver should sleep before its next
+        dispatch (0.0 = no spike) — the rtt-spike drill."""
+        prm = self.fire("delay_shard_link")
+        return float(prm.get("dur", 0.5)) if prm else 0.0
+
+    def net_half_open_seconds(self) -> float:
+        """Seconds the trainer's sends to one link should be silently
+        lost while receives still work (0.0 = healthy) — the half-open
+        peer drill."""
+        prm = self.fire("half_open_shard")
+        return float(prm.get("dur", 1.0)) if prm else 0.0
+
+    def garble_net_frame(self) -> bool:
+        """One opportunity per received net frame (the socket replay
+        link's dispatch path): True = flip frame bytes ahead of decode —
+        the frame CRC must catch it and, for a sample response, the
+        bounded retry must re-request."""
+        return self.fire("garble_net_frame") is not None
 
     def drop_response(self) -> bool:
         """One opportunity per served response token: True = the service

@@ -512,6 +512,27 @@ def test_league_acceptance_e2e(tmp_path):
             "never reached 2 eval sweeps on a live /statusz"
         assert live_league.get("members") == 2
         assert len(live_league.get("table") or []) == 2
+
+        def member_flow_on_statusz():
+            # poll-with-deadline (r07) instead of asserting the stop-
+            # time snapshot: under full-suite load the low-resource
+            # member can reach its second eval sweep before its FIRST
+            # block lands in replay — stopping at that instant raced
+            # the member-flow assertion below
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port['p']}/statusz",
+                        timeout=10) as r:
+                    status = json.loads(r.read())
+            except OSError:
+                return False
+            fleet = (status.get("last_entry") or {}).get("fleet") or {}
+            pop = (fleet.get("population") or {}).get("members") or []
+            return (len(pop) == 2
+                    and all(m.get("blocks", 0) > 0 for m in pop))
+
+        assert _poll(member_flow_on_statusz, 300, interval=0.3), \
+            "both members never showed routed blocks on a live /statusz"
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port['p']}/metrics", timeout=10) as r:
             metrics_text = r.read().decode()

@@ -70,6 +70,10 @@ def test_repo_tree_is_clean():
         # bounded measured bench producer thread (stop-event + joined),
         # same justification as bench.py's measured threads
         ("tools/replay_bench.py", "thread-discipline"),
+        # per-link net-replay receiver: owned by the link lifecycle
+        # (stopped by flag + joined in close()); a Supervisor restart
+        # loop would fight the link's own reconnect state machine
+        ("r2d2_tpu/parallel/replay_net.py", "thread-discipline"),
         # fixed 3-entry literal-name table publishing client-side latency
         # percentiles into the shared registry (not a hot-loop key)
         ("tools/session_load_gen.py", "telemetry-discipline"),
@@ -563,6 +567,47 @@ def test_wire_format_covers_session_socket_vocabulary():
 
         def dial(host, port):
             return socket.create_connection((host, port))
+    """), rules=["wire-format"])
+    assert report.findings == []
+
+
+def test_wire_format_covers_net_replay_vocabulary():
+    """The cross-host replay fabric's RPC vocabulary (ISSUE 14) is
+    wire-format-guarded on both transport signatures: a module speaking
+    the net replay protocol that redefines ``net_ingest_spec`` / a
+    ``NMSG_*`` kind constant (or uses ``net_sample_response_spec`` /
+    ``NMSG_PRIO`` without importing them from replay/netwire.py) is a
+    finding — a shard and a trainer framing from diverged specs mis-read
+    every later message."""
+    report = analyze_source(_src("""
+        import socket
+
+        NMSG_INGEST = 18
+
+        def net_ingest_spec(cfg, action_dim):
+            return ()
+
+        def route(sock, body):
+            return decode_frame(net_sample_response_spec(None, 4, 8),
+                                body)
+    """), rules=["wire-format"])
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "'net_ingest_spec' re-defined" in msgs
+    assert "'NMSG_INGEST' re-defined" in msgs   # restated kind constant
+    assert "'net_sample_response_spec' used without importing" in msgs
+    assert "r2d2_tpu.replay.netwire" in msgs
+    assert "'decode_frame' used without importing" in msgs
+    # the sanctioned shape — replay_net.py's own — is clean
+    report = analyze_source(_src("""
+        import socket
+        from r2d2_tpu.replay.netwire import (
+            NMSG_INGEST, NMSG_PRIO, net_ingest_spec,
+            net_sample_response_spec)
+        from r2d2_tpu.serving.wire import decode_frame, peek_kind
+
+        def route(body):
+            if peek_kind(body) == NMSG_INGEST:
+                return decode_frame(net_ingest_spec(None, 4), body)
     """), rules=["wire-format"])
     assert report.findings == []
 

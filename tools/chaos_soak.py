@@ -11,8 +11,9 @@ replay mass/size match the snapshot meta, the learner state restores,
 and training keeps advancing.  Exit code 1 on any violated invariant.
 
 Run:  python tools/chaos_soak.py [minutes] [--process] [--serve]
-                                 [--anakin] [--shards] [--trace]
-                                 [--sessions] [--league] [--out OUT.json]
+                                 [--anakin] [--shards] [--nethost]
+                                 [--trace] [--sessions] [--league]
+                                 [--out OUT.json]
 
 ``--process`` soaks the subprocess actor plane (enables the kill_fleet /
 garble_block sites); ``--serve`` additionally routes acting through the
@@ -32,7 +33,19 @@ armed: every round must finish with zero learner stalls, all shards
 alive (the watchdog respawned every kill), every garbled response
 caught-and-retried, and conserved priority accounting (the plane's
 training-step count equals the learner's updates — no feedback silently
-lost outside the counted cross-respawn drops).  ``--sessions`` soaks
+lost outside the counted cross-respawn drops).  ``--nethost`` soaks the
+CROSS-HOST replay fabric (``replay_transport="socket"``, loopback
+managed shards — the same wire path a remote deployment runs) with the
+socket failure sites armed on top of the shard kills: link partitions
+(``partition_shard_link``), rtt spikes (``delay_shard_link``),
+half-open peers (``half_open_shard``) and frame garbling
+(``garble_net_frame``).  Every round must finish with zero learner
+stalls, every link connected and every shard alive (partitions healed,
+kills respawned through the epoch handshake), and the same conserved
+priority accounting — stale cross-epoch feedback is COUNTED
+(stale_feedback/epoch_drops), never silently applied; the soak-level
+gate additionally requires >= 2 partitions and >= 1 shard kill to have
+actually fired and healed across the soak.  ``--sessions`` soaks
 the SESSION-SERVING tier (r2d2_tpu/serving, no trainer involved):
 rounds of synthetic episodic load with ``kill_session_client`` +
 ``slow_session_client`` armed and an LRU budget below the offered
@@ -72,6 +85,7 @@ _argv = sys.argv[1:]
 SERVE = "--serve" in _argv
 ANAKIN = "--anakin" in _argv
 SHARDS = "--shards" in _argv
+NETHOST = "--nethost" in _argv
 TRACE = "--trace" in _argv
 SESSIONS = "--sessions" in _argv
 LEAGUE = "--league" in _argv
@@ -311,6 +325,24 @@ def main() -> int:
                  ";stall_shard:every=350,dur=1.0,n=1000000")
         transport = dict(actor_transport="thread", num_actors=2)
         extra = dict(replay_shards=2, replay_sample_timeout=1.0)
+    elif NETHOST:
+        # cross-host replay fabric over loopback sockets: shard kills →
+        # respawn + epoch-handshake re-attach, link partitions → stale
+        # gossip view → strata redistribute then heal, half-open peers
+        # → RPC deadline + circuit, rtt spikes → rtt histogram, frame
+        # garbling → CRC drop + bounded retry.  No truncate_ckpt (the
+        # SHARDS rationale).  Partition opportunities count per-shard
+        # sample requests, so every=400 lands one partition roughly
+        # every ~10 s of real sampling traffic
+        chaos = ("freeze_learner:every=40,dur=0.5"
+                 ";kill_replay_shard:every=250,n=1000000"
+                 ";partition_shard_link:every=400,dur=1.5,n=1000000"
+                 ";delay_shard_link:every=700,dur=0.3,n=1000000"
+                 ";half_open_shard:every=900,dur=1.0,n=1000000"
+                 ";garble_net_frame:p=0.002")
+        transport = dict(actor_transport="thread", num_actors=2)
+        extra = dict(replay_shards=2, replay_transport="socket",
+                     replay_sample_timeout=1.0, replay_net_cooldown=1.0)
     elif PROCESS:
         chaos += ";kill_fleet:every=120;garble_block:p=0.005"
         transport = dict(actor_transport="process", num_actors=2,
@@ -482,7 +514,7 @@ def main() -> int:
                 # the point.)
                 if rnd > 1 and not m.get("restored_replay"):
                     failures.append(f"round {rnd}: resume came up cold")
-                if SHARDS:
+                if SHARDS or NETHOST:
                     rh = m.get("replay_shard_health") or {}
                     if m.get("learner_stalled"):
                         failures.append(
@@ -500,6 +532,16 @@ def main() -> int:
                             f"round {rnd}: feedback accounting "
                             f"{m.get('buffer_training_steps')} != "
                             f"updates {m['num_updates']}")
+                if NETHOST:
+                    nh = (m.get("replay_shard_health") or {}).get("net") \
+                        or {}
+                    # every partition healed, every kill re-attached: a
+                    # round must END with every link connected (the
+                    # sampled health is taken before teardown)
+                    if nh.get("connected") != rh.get("shards"):
+                        failures.append(
+                            f"round {rnd}: disconnected link at exit "
+                            f"({nh.get('connected')}/{rh.get('shards')})")
                 if ANAKIN and m.get("dispatch_wedged") \
                         and not ck.replay_steps():
                     failures.append(
@@ -558,6 +600,26 @@ def main() -> int:
         if not garbles:
             failures.append("garble_sample_response armed but no garbled "
                             "response was ever caught")
+    # soak-level invariants (--nethost): the committed-artifact gate —
+    # the drills must have actually FIRED (>= 2 partitions, >= 1 shard
+    # kill) and been answered (respawns cover kills; the per-round
+    # connected/alive/accounting checks above prove the heals)
+    if NETHOST and rounds:
+        kills = sum((r["chaos"] or {}).get("kill_replay_shard", 0)
+                    for r in rounds)
+        partitions = sum((r["chaos"] or {}).get("partition_shard_link", 0)
+                         for r in rounds)
+        respawns = sum(sum((r.get("replay_shards") or {})
+                           .get("respawns", [])) for r in rounds)
+        if kills < 1:
+            failures.append("nethost soak never fired a shard kill — "
+                            "lengthen the soak")
+        if partitions < 2:
+            failures.append(f"nethost soak fired only {partitions} "
+                            "partitions (need >= 2) — lengthen the soak")
+        if kills and respawns < kills:
+            failures.append(f"{kills} shard kills but only {respawns} "
+                            "respawns")
     # soak-level invariants (--league): every sidecar kill must have been
     # answered by an eval_watch respawn somewhere in the soak (a kill
     # landing in a round's final seconds may respawn next round), rows
