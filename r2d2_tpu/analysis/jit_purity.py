@@ -47,8 +47,13 @@ class _ModuleIndex:
     def __init__(self, tree: ast.AST):
         self.defs: Dict[str, List[ast.AST]] = {}
         # simple `name = factory(...)` assignments, for resolving
-        # `jax.jit(fn)` where fn was produced by a local factory
-        self.assigned_calls: Dict[str, ast.Call] = {}
+        # `jax.jit(fn)` where fn was produced by a local factory.  ALL
+        # assignments under one name are kept: two factories binding
+        # their pre-jit callable to the same local (e.g. ``wrapped =
+        # RETRACES.wrap(...)`` in sibling factories) must union their
+        # candidates — last-wins resolution silently dropped every
+        # earlier factory's function graph from the root set
+        self.assigned_calls: Dict[str, List[ast.Call]] = {}
         for node in ast.walk(tree):
             if isinstance(node, _FuncNode):
                 self.defs.setdefault(node.name, []).append(node)
@@ -56,7 +61,8 @@ class _ModuleIndex:
                 t = node.targets[0]
                 if isinstance(t, ast.Name) and isinstance(node.value,
                                                           ast.Call):
-                    self.assigned_calls[t.id] = node.value
+                    self.assigned_calls.setdefault(t.id, []).append(
+                        node.value)
 
     def returned_functions(self, func: ast.AST) -> List[ast.AST]:
         """Function nodes a factory returns (``return inner`` /
@@ -78,21 +84,28 @@ class _ModuleIndex:
                     out.extend(self._resolve_seed(v.args[0]))
         return out
 
-    def _resolve_seed(self, node) -> List[ast.AST]:
-        """Function nodes a jit-call argument ultimately names."""
+    def _resolve_seed(self, node, _visiting: Optional[Set[str]] = None
+                      ) -> List[ast.AST]:
+        """Function nodes a jit-call argument ultimately names.
+        ``_visiting`` breaks rebinding cycles (``fn = wrap("n", fn)``)."""
+        if _visiting is None:
+            _visiting = set()
         if isinstance(node, ast.Lambda):
             return [node]
         if isinstance(node, ast.Name):
             if node.id in self.defs:
                 return list(self.defs[node.id])
-            call = self.assigned_calls.get(node.id)
-            if call is not None:
-                return self._resolve_seed(call)
-            return []
+            if node.id in _visiting:
+                return []
+            _visiting.add(node.id)
+            out: List[ast.AST] = []
+            for call in self.assigned_calls.get(node.id, []):
+                out.extend(self._resolve_seed(call, _visiting))
+            return out
         if isinstance(node, ast.Call):
             d = dotted_name(node.func) or ""
             if d in _PARTIAL_NAMES and node.args:
-                return self._resolve_seed(node.args[0])
+                return self._resolve_seed(node.args[0], _visiting)
             if d.endswith(".wrap") or d == "retrace_wrap":
                 # utils.trace.RETRACES.wrap("name", fn, ...): the traced
                 # function is the first non-string argument
@@ -100,7 +113,7 @@ class _ModuleIndex:
                 for a in node.args:
                     if isinstance(a, ast.Constant):
                         continue
-                    out.extend(self._resolve_seed(a))
+                    out.extend(self._resolve_seed(a, _visiting))
                 return out
             # factory call: the jitted function is what the factory returns
             if isinstance(node.func, ast.Name):

@@ -98,9 +98,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "channel — use for GIL-bound envs / many cores), "
                         "or 'anakin' (the Podracer fused on-device loop: "
                         "env+actor+replay+learner as ONE jitted program "
-                        "over the pure-JAX fake env — zero host crossings "
-                        "on the hot path; implies device_replay and "
-                        "in_graph_per)")
+                        "over a pure-JAX env (--anakin-env) — zero host "
+                        "crossings on the hot path; implies device_replay "
+                        "and in_graph_per; with --mesh the fused program "
+                        "shards over the dp x fsdp x tp mesh)")
     p.add_argument("--actor-inference", choices=("local", "serve"),
                    default=None,
                    help="process-transport acting: 'local' (each fleet "
@@ -225,7 +226,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="GSPMD learner over all visible devices: one "
                          "table-driven pjit train step on the dp x fsdp x "
                          "tp mesh (cfg.mesh_shape; default puts every "
-                         "device on dp)")
+                         "device on dp).  With --actor-transport anakin "
+                         "the whole fused super-step compiles through the "
+                         "sharded entry point instead — lanes, carry, "
+                         "local buffers and ring/PER over dp, "
+                         "params/moments per the table")
+    pt.add_argument("--anakin-env", choices=("fake", "grid"), default=None,
+                    help="anakin transport: which jittable env the fused "
+                         "loop steps — 'fake' (the vmapped FakeAtariEnv "
+                         "twin; default) or 'grid' (the goal-seeking "
+                         "gridworld, envs/grid.py).  Any env on the "
+                         "envs/anakin.py four-method surface inherits the "
+                         "whole fast path; overrides cfg.anakin_env")
+    pt.add_argument("--anakin-eval-interval", type=int, default=None,
+                    metavar="N",
+                    help="anakin transport: run the in-graph greedy eval "
+                         "lane every N fused dispatches (epsilon=0 "
+                         "episodes inside the compiled program, results "
+                         "riding the per-dispatch result vector — "
+                         "learning curves with no host env; 0 disables, "
+                         "the default); overrides cfg.anakin_eval_interval")
     pt.add_argument("--sharding-table", default=None, metavar="SPEC",
                     help="override/extend the per-param sharding table "
                          "(parallel/sharding.py), e.g. "
@@ -383,6 +403,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cfg = cfg.replace(replay_transport=args.replay_transport)
             if args.sharding_table is not None:
                 cfg = cfg.replace(sharding_table=args.sharding_table)
+            if args.anakin_env is not None:
+                cfg = cfg.replace(anakin_env=args.anakin_env)
+            if args.anakin_eval_interval is not None:
+                cfg = cfg.replace(
+                    anakin_eval_interval=args.anakin_eval_interval)
             if args.population is not None:
                 cfg = cfg.replace(population_spec=args.population)
             if args.league_eval:

@@ -732,11 +732,31 @@ class Config:
                                       # fabric gets implicitly; 4 mirrors
                                       # train_sync's default interleave)
     anakin_episode_len: int = 32      # anakin transport: the pure-JAX
-                                      # fake env's truncation length
+                                      # env's truncation length
                                       # (envs/anakin.py; must be <=
                                       # max_episode_steps — the fused
                                       # loop relies on truncation firing
                                       # before the episode-step cap)
+    anakin_env: str = "fake"          # anakin transport: which jittable
+                                      # env the fused loop steps —
+                                      # "fake" (the vmapped FakeAtariEnv
+                                      # twin) or "grid" (the goal-
+                                      # seeking gridworld, envs/grid.py
+                                      # oracle).  Both run through the
+                                      # UNCHANGED fused program via the
+                                      # envs/anakin.py four-method
+                                      # surface (make_anakin_env)
+    anakin_eval_interval: int = 0     # anakin transport: >0 runs an
+                                      # in-graph GREEDY eval lane every
+                                      # N fused dispatches (lax.cond-
+                                      # gated: one truncation-length
+                                      # episode per lane with epsilon=0,
+                                      # results riding the existing
+                                      # per-dispatch result vector) so
+                                      # anakin learning curves need no
+                                      # host env; 0 (default) disables
+                                      # — the compiled program then
+                                      # carries no eval branch
     fused_double_unroll: bool = False  # compute the online+target forwards
                                       # as ONE unroll vmapped over stacked
                                       # params: half the sequential LSTM
@@ -808,6 +828,15 @@ class Config:
             raise ValueError("anakin_env_steps_per_update must be >= 1")
         if self.anakin_episode_len < 1:
             raise ValueError("anakin_episode_len must be >= 1")
+        if self.anakin_env not in ("fake", "grid"):
+            raise ValueError(
+                f"unknown anakin_env {self.anakin_env!r} (expected 'fake' "
+                "or 'grid' — a custom jittable env plugs in at the "
+                "envs/anakin.py four-method surface)")
+        if self.anakin_eval_interval < 0:
+            raise ValueError(
+                "anakin_eval_interval must be >= 0 (0 disables the "
+                "in-graph eval lane)")
         if (self.actor_transport == "anakin"
                 and self.anakin_episode_len > self.max_episode_steps):
             raise ValueError(

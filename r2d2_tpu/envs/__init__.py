@@ -7,6 +7,7 @@
 framework is runnable and testable without the Atari ROMs.
 """
 from r2d2_tpu.envs.fake import FakeAtariEnv
+from r2d2_tpu.envs.grid import GridWorldEnv
 from r2d2_tpu.envs.atari import (
     NoopResetEnv,
     WarpFrame,
@@ -16,6 +17,7 @@ from r2d2_tpu.envs.atari import (
 
 __all__ = [
     "FakeAtariEnv",
+    "GridWorldEnv",
     "NoopResetEnv",
     "WarpFrame",
     "atari_available",

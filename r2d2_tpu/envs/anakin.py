@@ -32,8 +32,11 @@ API shape (functional, all methods safe under jit/vmap/scan):
 - ``reset_lanes(state, mask) -> state'``: redraw phase / zero the step
   counter for masked lanes only.
 
-Any future jittable env (gridworlds, procgen-style) that implements this
-same four-method surface inherits the anakin fast path for free.
+Any jittable env that implements this same four-method surface (plus a
+``STATE_KEYS`` tuple naming its per-lane state-pytree entries) inherits
+the anakin fast path for free — :class:`AnakinGridEnv` below is the
+second proof after the fake env, and :func:`make_anakin_env` is the
+selection point (``cfg.anakin_env``) the trainer resolves through.
 """
 from __future__ import annotations
 
@@ -42,6 +45,25 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from r2d2_tpu.envs.grid import AGENT_PIXEL, GOAL_PIXEL, GRID
+
+
+def make_anakin_env(cfg, action_dim: int):
+    """The anakin transport's env-selection point: resolve
+    ``cfg.anakin_env`` to a jittable env over ``cfg.num_actors`` lanes.
+    Both built-ins share the 4-action set; a custom jittable env plugs in
+    by implementing the same four-method surface and being returned from
+    here (train() hard-errors on host env factories in anakin mode — the
+    env must be jnp ops, not a subprocess)."""
+    kind = getattr(cfg, "anakin_env", "fake")
+    cls = {"fake": AnakinFakeEnv, "grid": AnakinGridEnv}.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown anakin_env {kind!r} "
+                         "(expected 'fake' or 'grid')")
+    return cls(obs_shape=cfg.stored_obs_shape, action_dim=action_dim,
+               episode_len=cfg.anakin_episode_len,
+               num_lanes=cfg.num_actors)
 
 
 class AnakinFakeEnv:
@@ -53,6 +75,10 @@ class AnakinFakeEnv:
       ``t`` (N,) int32 — steps into the current episode,
       ``key`` (N, 2) uint32 — per-lane reset-phase streams.
     """
+
+    # the env-state pytree entries the fused loop carries as
+    # ``ast["env_<key>"]`` (learner/anakin.py) and the snapshot persists
+    STATE_KEYS = ("phase", "t", "key")
 
     def __init__(self, obs_shape: Tuple[int, ...] = (84, 84, 1),
                  action_dim: int = 4, episode_len: int = 32,
@@ -147,3 +173,138 @@ class AnakinFakeEnv:
         phase = int(jax.random.randint(sub, (), 0, self.action_dim,
                                        dtype=jnp.int32))
         return np.asarray(k_next), phase
+
+
+class AnakinGridEnv:
+    """Vmapped, jit-safe :class:`~r2d2_tpu.envs.grid.GridWorldEnv` twin —
+    the second jittable env through the four-method surface (the "fast
+    path for free" proof: the fused program in learner/anakin.py runs it
+    UNCHANGED).
+
+    State pytree (all device arrays, N = num_lanes):
+      ``agent`` (N,) int32 — the agent's flattened board cell,
+      ``goal`` (N,) int32 — the goal's flattened board cell,
+      ``t`` (N,) int32 — steps into the current episode,
+      ``key`` (N, 2) uint32 — per-lane reset-draw streams.
+
+    Bit-exactness contract (tests/test_anakin.py): given the same reset
+    draws, ``step``/``observe`` reproduce the numpy env's observation
+    bytes, rewards and truncation flags exactly — in-episode dynamics
+    (moves, goal relocation) are deterministic integer arithmetic, so
+    the replay-the-reset-draws parity scheme covers the whole episode.
+    """
+
+    STATE_KEYS = ("agent", "goal", "t", "key")
+
+    def __init__(self, obs_shape: Tuple[int, ...] = (84, 84, 1),
+                 action_dim: int = 4, episode_len: int = 32,
+                 num_lanes: int = 1):
+        if action_dim != 4:
+            raise ValueError(
+                f"AnakinGridEnv has exactly 4 move actions, got "
+                f"action_dim {action_dim}")
+        self.obs_shape = tuple(obs_shape)
+        self.action_dim = int(action_dim)
+        self.episode_len = int(episode_len)
+        self.num_lanes = int(num_lanes)
+
+    # ------------------------------------------------------------ lifecycle
+    def init_state(self, key: jax.Array) -> dict:
+        lanes = jnp.arange(self.num_lanes, dtype=jnp.uint32)
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(lanes)
+        state = dict(
+            agent=jnp.zeros(self.num_lanes, jnp.int32),
+            goal=jnp.ones(self.num_lanes, jnp.int32),
+            t=jnp.zeros(self.num_lanes, jnp.int32),
+            key=keys,
+        )
+        return self.reset_lanes(state, jnp.ones(self.num_lanes, bool))
+
+    def reset_lanes(self, state: dict, mask: jax.Array) -> dict:
+        """Redraw agent and goal cells (goal uniform over the other
+        ``GRID**2 - 1`` cells — the numpy env's exact scheme) and zero the
+        step counter for masked lanes.  Unmasked lanes are untouched,
+        including their RNG stream position.  Per-lane draws are
+        elementwise in the lane axis, so a dp-sharded lane layout cannot
+        change the generated bits (unlike fleet-wide counter-based
+        draws — learner/anakin.py pins those replicated instead)."""
+        m = GRID * GRID
+
+        def draw(k):
+            k_next, s1, s2 = jax.random.split(k, 3)
+            agent = jax.random.randint(s1, (), 0, m, dtype=jnp.int32)
+            d = jax.random.randint(s2, (), 0, m - 1, dtype=jnp.int32)
+            goal = d + (d >= agent).astype(jnp.int32)
+            return k_next, agent, goal
+
+        new_key, new_agent, new_goal = jax.vmap(draw)(state["key"])
+        return dict(
+            agent=jnp.where(mask, new_agent, state["agent"]),
+            goal=jnp.where(mask, new_goal, state["goal"]),
+            t=jnp.where(mask, 0, state["t"]),
+            key=jnp.where(mask[:, None], new_key, state["key"]),
+        )
+
+    # ------------------------------------------------------------- dynamics
+    def observe(self, state: dict) -> jax.Array:
+        """(N, *obs_shape) uint8 — agent cell bright (255), goal cell dim
+        (128), vectorized over lanes; the numpy ``_obs`` block layout."""
+        h, w = self.obs_shape[:2]
+        ch, cw = max(1, h // GRID), max(1, w // GRID)
+        rows = jnp.arange(h, dtype=jnp.int32)
+        cols = jnp.arange(w, dtype=jnp.int32)
+
+        def cell_mask(idx):                       # (N,) -> (N, H, W) bool
+            r, c = idx // GRID, idx % GRID
+            rm = ((rows[None, :] >= (r * ch)[:, None])
+                  & (rows[None, :] < ((r + 1) * ch)[:, None]))
+            cm = ((cols[None, :] >= (c * cw)[:, None])
+                  & (cols[None, :] < ((c + 1) * cw)[:, None]))
+            return rm[:, :, None] & cm[:, None, :]
+
+        img = jnp.where(cell_mask(state["goal"]), jnp.uint8(GOAL_PIXEL),
+                        jnp.uint8(0))
+        img = jnp.where(cell_mask(state["agent"]), jnp.uint8(AGENT_PIXEL),
+                        img)
+        extra = (1,) * (len(self.obs_shape) - 2)
+        img = img.reshape(img.shape + extra)
+        return jnp.broadcast_to(
+            img, (state["agent"].shape[0], *self.obs_shape))
+
+    def step(self, state: dict, actions: jax.Array
+             ) -> Tuple[dict, jax.Array, jax.Array]:
+        """One lockstep move for every lane — GridWorldEnv.step exactly:
+        clamped moves, +1.0 on reaching the goal, deterministic goal
+        relocation (scan order, skipping the agent), truncation at
+        ``episode_len``.  No RNG is consumed (randomness is reset-only,
+        the fake env's discipline).  Lanes are NOT auto-reset."""
+        a = actions.astype(jnp.int32)
+        r, c = state["agent"] // GRID, state["agent"] % GRID
+        dr = jnp.asarray((-1, 1, 0, 0), jnp.int32)[a]
+        dc = jnp.asarray((0, 0, -1, 1), jnp.int32)[a]
+        r = jnp.clip(r + dr, 0, GRID - 1)
+        c = jnp.clip(c + dc, 0, GRID - 1)
+        agent = r * GRID + c
+        reached = agent == state["goal"]
+        reward = jnp.where(reached, jnp.float32(1.0), jnp.float32(0.0))
+        m = GRID * GRID
+        g1 = (state["goal"] + 1) % m              # grid.next_goal, vmapped
+        g1 = jnp.where(g1 == agent, (g1 + 1) % m, g1)
+        goal = jnp.where(reached, g1, state["goal"])
+        t = state["t"] + 1
+        truncated = t >= self.episode_len
+        return (dict(agent=agent, goal=goal, t=t, key=state["key"]),
+                reward, truncated)
+
+    # ----------------------------------------------------- host-side mirror
+    def host_reset_draw(self, key: np.ndarray) -> Tuple[np.ndarray, int, int]:
+        """One lane's reset draw on the host — the parity tests use it to
+        force the numpy oracle's agent/goal to this env's stream (module
+        docstring).  Returns ``(next_key, agent, goal)`` with identical
+        values to the in-graph draw."""
+        m = GRID * GRID
+        k = jnp.asarray(key, jnp.uint32)
+        k_next, s1, s2 = jax.random.split(k, 3)
+        agent = int(jax.random.randint(s1, (), 0, m, dtype=jnp.int32))
+        d = int(jax.random.randint(s2, (), 0, m - 1, dtype=jnp.int32))
+        return np.asarray(k_next), agent, d + (1 if d >= agent else 0)

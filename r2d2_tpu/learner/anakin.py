@@ -6,8 +6,10 @@ env-step → act → block-cut → replay-write → train-step become ONE compil
 program, and the host's only jobs are dispatching it and reading a few
 scalars back.  This module is that program for the R2D2 stack:
 
-- the env is the pure-JAX :class:`~r2d2_tpu.envs.anakin.AnakinFakeEnv`
-  (vmapped FakeAtariEnv dynamics);
+- the env is a pure-JAX four-method env (``cfg.anakin_env`` →
+  :func:`~r2d2_tpu.envs.anakin.make_anakin_env`: the vmapped
+  FakeAtariEnv twin or the gridworld — any env on that surface inherits
+  this whole fast path);
 - the actor is an in-graph twin of :class:`~r2d2_tpu.actor.VectorActor`'s
   hot loop — per-lane ladder epsilons, LSTM carry, deferred block-boundary
   cuts with bootstrap Q, episode lifecycle — over a device-resident twin
@@ -28,8 +30,9 @@ scalars back.  This module is that program for the R2D2 stack:
 Each dispatch of the fused super-step runs ``k × (E env/actor steps + 1
 optimizer step)`` under ``jax.lax.scan`` (E =
 ``cfg.anakin_env_steps_per_update``), crossing the host boundary exactly
-twice: one uint32 dispatch counter up, one flat (k + 5) float vector
-(losses + counter deltas) down.  Both crossings are ticked on
+twice: one uint32 dispatch counter up, one small flat float vector
+(k losses + counter deltas, then the eval pair / learnhealth rows when
+armed) down.  Both crossings are ticked on
 ``HOST_TRANSFERS`` and the e2e tests pin them to a constant per dispatch,
 independent of lane count, batch size and k — the "zero host crossings"
 acceptance gate of ROADMAP open item 2.
@@ -57,9 +60,10 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from r2d2_tpu.config import Config
-from r2d2_tpu.envs.anakin import AnakinFakeEnv
+from r2d2_tpu.envs.anakin import make_anakin_env
 from r2d2_tpu.learner.step import (
     TrainState,
     _in_graph_sample,
@@ -77,6 +81,84 @@ log = logging.getLogger(__name__)
 # host-facing stats appended to the losses in the per-dispatch result
 # vector, in this order (all float32; the deltas are per-dispatch)
 STATS_FIELDS = ("env_steps", "fill", "episodes", "reward_sum", "blocks")
+
+# in-graph greedy eval lane fields, appended after STATS_FIELDS when
+# cfg.anakin_eval_interval > 0 (zeros on off-cadence dispatches)
+EVAL_FIELDS = ("eval_episodes", "eval_return_sum")
+
+
+def _mesh_hooks(table):
+    """The fused program's layout-invariance hooks over one table:
+    ``rep`` pins a value replicated (threefry draws, the stratified
+    draw's cumsum input — the PR 8 pins extended to the fused program),
+    ``rows`` pins sampled batch rows to dp (so the gather and the
+    forward/backward shard exactly as the pjit drivetrains')."""
+    rep_sh = table.replicated()
+    dp_sh = NamedSharding(table.mesh, P("dp"))
+
+    def rep(x):
+        return jax.lax.with_sharding_constraint(x, rep_sh)
+
+    def rows(x):
+        return jax.lax.with_sharding_constraint(x, dp_sh)
+
+    return rep, rows
+
+
+def _make_eval_lane(cfg: Config, net: R2D2Network, env: Any,
+                    action_dim: int):
+    """The in-graph greedy eval lane: every ``cfg.anakin_eval_interval``
+    dispatches (``lax.cond``-gated — off-cadence dispatches pay a zeros
+    fill, not the rollout), run ONE truncation-length episode per lane
+    with epsilon = 0 from a fresh env state (stream: a distinct
+    ``fold_in`` derivation over the dispatch index, so eval episodes are
+    reproducible and never perturb the training streams), and return
+    ``(2,)`` f32 ``[episodes, return_sum]`` riding the existing
+    per-dispatch result vector — anakin learning curves without a host
+    env.  Greedy argmax + per-lane env draws are elementwise in the lane
+    axis, so the lane needs no extra layout pins."""
+    N, A = cfg.num_actors, action_dim
+    layers, H = cfg.lstm_layers, cfg.hidden_dim
+    act_net = _loss_net(cfg, net)
+    interval = cfg.anakin_eval_interval
+    steps = cfg.anakin_episode_len
+
+    def eval_rollout(params, dispatch_idx):
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0x45564C),
+            dispatch_idx)
+        est = env.init_state(key)
+        carry0 = (est, env.observe(est),
+                  jnp.zeros((N, A), jnp.float32), jnp.zeros(N, jnp.float32),
+                  jnp.zeros((N, 2, layers, H), jnp.float32),
+                  jnp.zeros(N, jnp.float32), jnp.zeros(N, bool))
+
+        def estep(c, _):
+            est, obs, la, lr, hidden, ret, done = c
+            q, h2 = act_net.apply(params, obs, la, lr, hidden,
+                                  method=R2D2Network.act)
+            a = jnp.argmax(q, axis=1).astype(jnp.int32)
+            est2, reward, trunc = env.step(est, a)
+            # the truncating step's reward still counts (it ends the
+            # episode); anything after a lane's done flag does not
+            ret = ret + jnp.where(done, 0.0, reward)
+            done = done | trunc
+            one_hot = jnp.zeros((N, A), jnp.float32).at[
+                jnp.arange(N), a].set(1.0)
+            return (est2, env.observe(est2), one_hot, reward, h2, ret,
+                    done), None
+
+        carry, _ = jax.lax.scan(estep, carry0, None, length=steps)
+        ret, done = carry[5], carry[6]
+        return jnp.stack([done.sum().astype(jnp.float32), ret.sum()])
+
+    def eval_lane(params, dispatch_idx):
+        do = (dispatch_idx % jnp.uint32(interval)) == 0
+        return jax.lax.cond(do,
+                            lambda _: eval_rollout(params, dispatch_idx),
+                            lambda _: jnp.zeros(2, jnp.float32), 0)
+
+    return eval_lane
 
 
 def _gamma_tables(cfg: Config):
@@ -225,8 +307,9 @@ def _make_emit(cfg: Config, action_dim: int, done: bool):
     return emit
 
 
-def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
-                     action_dim: int, cut_cond: bool = True):
+def _make_actor_step(cfg: Config, net: R2D2Network, env: Any,
+                     action_dim: int, cut_cond: bool = True,
+                     replicate=None):
     """One fused env/actor step for the whole fleet — the jnp twin of one
     ``VectorActor.run`` iteration, same sub-step order (boundary cuts with
     this step's bootstrap Q first, then act/step/record, then episode-end
@@ -241,7 +324,15 @@ def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
     executing as all-masked no-ops.  Bit-exact by construction — a no-cut
     emit writes only to the dropped sentinel slot and a no-cut retention
     is the identity — and pinned vs the ``cut_cond=False`` path in
-    tests/test_anakin.py."""
+    tests/test_anakin.py.
+
+    ``replicate`` (mesh mode) pins the fleet-wide exploration draws to a
+    replicated layout: with non-partitionable threefry, GSPMD
+    back-propagating a dp sharding onto a counter-based ``(N,)`` draw
+    changes the generated BITS (the PR 8 finding on the stratified
+    draw's uniforms), so without the pin a dp=2 run would explore
+    differently than dp=1.  Per-lane vmapped draws (the env's reset
+    streams) are elementwise in the lane axis and need no pin."""
     N, A, BL = cfg.num_actors, action_dim, cfg.block_length
     cap = cfg.max_block_steps
     eps = jnp.asarray([epsilon_ladder(i, cfg.num_actors, cfg.base_eps,
@@ -250,6 +341,7 @@ def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
     act_net = _loss_net(cfg, net)  # the scan recurrence, grad-safe twin
     emit_boundary = _make_emit(cfg, action_dim, done=False)
     emit_done = _make_emit(cfg, action_dim, done=True)
+    env_keys = tuple(env.STATE_KEYS)
     lanes = jnp.arange(N)
 
     def actor_step(params, ast, arrays, prios, seq_meta, first):
@@ -277,13 +369,17 @@ def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
 
         # 2) ladder-epsilon exploration
         key, k1, k2 = jax.random.split(ast["act_key"], 3)
-        explore = jax.random.uniform(k1, (N,)) < eps
+        u = jax.random.uniform(k1, (N,))
         rand_a = jax.random.randint(k2, (N,), 0, A, dtype=jnp.int32)
+        if replicate is not None:
+            # layout-invariance pin: see the factory docstring
+            u, rand_a = replicate(u), replicate(rand_a)
+        explore = u < eps
         actions = jnp.where(explore, rand_a,
                             jnp.argmax(q, axis=1).astype(jnp.int32))
 
         # 3) env step (no auto-reset: the post-step obs is recorded first)
-        env_state = {k: ast["env_" + k] for k in ("phase", "t", "key")}
+        env_state = {k: ast["env_" + k] for k in env_keys}
         env_state, reward, truncated = env.step(env_state, actions)
         obs_step = env.observe(env_state)
 
@@ -312,8 +408,7 @@ def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
                "sum_reward": ast["sum_reward"] + reward,
                "episode_steps": ast["episode_steps"] + 1,
                "act_key": key,
-               "env_phase": env_state["phase"], "env_t": env_state["t"],
-               "env_key": env_state["key"]}
+               **{f"env_{k}": env_state[k] for k in env_keys}}
 
         # 5) episode-end cuts (terminal: zero bootstrap); same cond fast
         #    path — episode ends are rarer still than block boundaries
@@ -361,8 +456,7 @@ def _make_actor_step(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
                "buf_hidden": ast["buf_hidden"].at[:, 0].set(
                    jnp.where(tr[:, None, None, None], 0.0,
                              ast["buf_hidden"][:, 0])),
-               "env_phase": env_state["phase"], "env_t": env_state["t"],
-               "env_key": env_state["key"]}
+               **{f"env_{k}": env_state[k] for k in env_keys}}
 
         # 7) deferred boundary cut next step (worker.py block-cut rule)
         ast = {**ast,
@@ -428,11 +522,12 @@ def _stats_vec(ast: dict) -> jnp.ndarray:
         ast["blocks_d"].astype(jnp.float32)])
 
 
-def make_anakin_state(cfg: Config, action_dim: int, env: AnakinFakeEnv,
+def make_anakin_state(cfg: Config, action_dim: int, env: Any,
                       key: jax.Array) -> dict:
     """The fused loop's full device-resident carry (host-built, one
-    device_put): env state, batched agent state, the VectorLocalBuffer
-    twin, ring pointer/accounting, and the exploration RNG."""
+    device_put): env state (whatever pytree ``env.STATE_KEYS`` names),
+    batched agent state, the VectorLocalBuffer twin, ring
+    pointer/accounting, and the exploration RNG."""
     N, A, BL = cfg.num_actors, action_dim, cfg.block_length
     cap = cfg.max_block_steps
     obs_shape = cfg.stored_obs_shape
@@ -445,8 +540,7 @@ def make_anakin_state(cfg: Config, action_dim: int, env: AnakinFakeEnv,
     buf_la = np.zeros((N, cap, A), bool)
     buf_la[:, 0, 0] = True                    # noop one-hot at stream start
     ast = dict(
-        env_phase=env_state["phase"], env_t=env_state["t"],
-        env_key=env_state["key"],
+        **{f"env_{k}": env_state[k] for k in env.STATE_KEYS},
         obs=obs0,
         last_action=jnp.zeros((N, A), jnp.float32),
         last_reward=jnp.zeros(N, jnp.float32),
@@ -472,31 +566,64 @@ def make_anakin_state(cfg: Config, action_dim: int, env: AnakinFakeEnv,
     return _zero_deltas(ast)
 
 
+def _anakin_shardings(table, state_template, ast_template, layout: str):
+    """(state, ast, ring, prios, seq_meta, first) sharding trees for the
+    fused entry points — every piece resolved through the ONE sharding
+    table (parallel/sharding.py): params/moments per the param-path
+    patterns (fsdp/tp), lane state per ``anakin.lane.*`` (dp), ring/PER
+    per ``ring.*``/``per.*`` under the ring layout."""
+    per = table.per_shardings(layout)
+    return (table.state_shardings(state_template),
+            table.anakin_state_shardings(ast_template, layout),
+            table.ring_shardings(layout),
+            per["prios"], per["seq_meta"], per["first"])
+
+
 def make_anakin_super_step(cfg: Config, net: R2D2Network,
-                           env: AnakinFakeEnv, action_dim: int,
-                           cut_cond: bool = True):
+                           env: Any, action_dim: int,
+                           cut_cond: bool = True, table=None,
+                           state_template=None, ast_template=None,
+                           layout: str = "replicated"):
     """The fused program: ``k × (E env/actor steps + 1 train step)`` in one
     dispatch.  Signature::
 
         super_step(train_state, anakin_state, ring_arrays, prios,
                    seq_meta, first_burn, dispatch_idx u32)
           -> (train_state', anakin_state', ring_arrays', prios',
-              seq_meta', first_burn', flat (k + 5) f32)
+              seq_meta', first_burn', flat f32)
 
     All six state arguments are donated; ``flat`` is the per-inner-step
-    losses followed by the :data:`STATS_FIELDS` deltas — the dispatch's
-    ONLY device→host payload.  With ``cfg.learnhealth_interval > 0`` the
-    per-inner-step learnhealth diagnostic rows (telemetry/learnhealth.py;
-    zeros off-cadence) are appended to the SAME flat vector, so the
-    host-crossing count per dispatch is unchanged.  The sampling stream
-    is ``fold_in(PRNGKey(cfg.seed), dispatch_idx)``, matching the
+    losses followed by the :data:`STATS_FIELDS` deltas (then the
+    :data:`EVAL_FIELDS` pair when ``cfg.anakin_eval_interval > 0``, then
+    the learnhealth diagnostic rows when armed) — the dispatch's ONLY
+    device→host payload at every mesh shape.  The sampling stream is
+    ``fold_in(PRNGKey(cfg.seed), dispatch_idx)``, matching the
     ``in_graph_per`` drivetrain's scheme (learner/step.py).
-    """
+
+    ``table`` (mesh mode) makes this THE one
+    ``jax.jit(in_shardings=..., out_shardings=..., donate_argnums=...)``
+    entry point over the dp × fsdp × tp mesh: lanes/carry/local buffers
+    shard over dp, params/moments per the table's patterns, ring/PER per
+    ``layout``; the stratified draw and the fleet-wide exploration
+    draws are pinned replicated (the PR 8 cumsum/threefry pins), and
+    sampled batch rows are pinned to dp so the train step shards exactly
+    as the pjit drivetrains'.  ``table=None`` is the single-device path
+    — the same program, default placement."""
     k, E = cfg.superstep_k, cfg.anakin_env_steps_per_update
     lh = getattr(cfg, "learnhealth_interval", 0) > 0
+    rep = rows = None
+    if table is not None:
+        if state_template is None or ast_template is None:
+            raise ValueError(
+                "mesh-mode make_anakin_super_step needs state_template "
+                "and ast_template to resolve the table shardings — "
+                "compiling without them would silently bypass the layout")
+        rep, rows = _mesh_hooks(table)
     step = make_train_step(cfg, net, learnhealth=lh)
     actor_step = _make_actor_step(cfg, net, env, action_dim,
-                                  cut_cond=cut_cond)
+                                  cut_cond=cut_cond, replicate=rep)
+    eval_lane = (_make_eval_lane(cfg, net, env, action_dim)
+                 if cfg.anakin_eval_interval > 0 else None)
 
     def super_step(train_state: TrainState, ast, arrays, prios, seq_meta,
                    first, dispatch_idx):
@@ -515,8 +642,17 @@ def make_anakin_super_step(cfg: Config, net: R2D2Network,
             (ast, arrays, prios, seq_meta, first), _ = jax.lax.scan(
                 env_it, (ast, arrays, prios, seq_meta, first), None,
                 length=E)
-            idx, w, ints = _in_graph_sample(cfg, key_t, prios, seq_meta,
-                                            first)
+            # mesh mode: the draw reads a REPLICATED view of the leaves
+            # and its uniforms are pinned replicated (learner/step.py's
+            # in_graph_per rationale — associative_scan partitioning
+            # changes final-ulp rounding, threefry partitioning changes
+            # bits); the sampled rows then pin to dp so gather/forward
+            # shard over the mesh
+            p_draw = prios if rep is None else rep(prios)
+            idx, w, ints = _in_graph_sample(cfg, key_t, p_draw, seq_meta,
+                                            first, constrain_rep=rep)
+            if rows is not None:
+                ints, w = rows(ints), rows(w)
             batch = gather_batch(cfg, arrays, ints, w)
             if lh:
                 ts, loss, new_p, diag = step(ts, batch)
@@ -532,22 +668,45 @@ def make_anakin_super_step(cfg: Config, net: R2D2Network,
                                   seq_meta, first), keys))
         if lh:
             losses, diags = ys
-            flat = jnp.concatenate([losses, _stats_vec(ast),
-                                    diags.reshape(-1)])
         else:
-            flat = jnp.concatenate([ys, _stats_vec(ast)])
+            losses, diags = ys, None
+        parts = [losses, _stats_vec(ast)]
+        if eval_lane is not None:
+            parts.append(eval_lane(train_state.params, dispatch_idx))
+        if diags is not None:
+            parts.append(diags.reshape(-1))
+        flat = jnp.concatenate(parts)
         return train_state, ast, arrays, prios, seq_meta, first, flat
 
-    return jax.jit(RETRACES.wrap("learner.anakin_super_step", super_step),
+    wrapped = RETRACES.wrap("learner.anakin_super_step", super_step)
+    if table is None:
+        return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3, 4, 5))
+    from r2d2_tpu.parallel.sharding import (
+        _check_batch,
+        _silence_benign_donation_warning,
+    )
+
+    _silence_benign_donation_warning()
+    _check_batch(cfg, table.mesh)
+    sh = _anakin_shardings(table, state_template, ast_template, layout)
+    return jax.jit(wrapped,
+                   in_shardings=sh + (table.replicated(),),
+                   out_shardings=sh + (table.replicated(),),
                    donate_argnums=(0, 1, 2, 3, 4, 5))
 
 
-def make_anakin_rollout(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
-                        action_dim: int, steps: int):
+def make_anakin_rollout(cfg: Config, net: R2D2Network, env: Any,
+                        action_dim: int, steps: int, table=None,
+                        state_template=None, ast_template=None,
+                        layout: str = "replicated"):
     """The warm-up program: ``steps`` fused env/actor steps with ring/PER
     writes but NO train step — dispatched until the in-graph fill counter
-    reaches ``learning_starts``.  Params are read-only (not donated)."""
-    actor_step = _make_actor_step(cfg, net, env, action_dim)
+    reaches ``learning_starts``.  Params are read-only (not donated).
+    ``table`` shards it exactly like :func:`make_anakin_super_step`."""
+    rep = None
+    if table is not None:
+        rep, _ = _mesh_hooks(table)
+    actor_step = _make_actor_step(cfg, net, env, action_dim, replicate=rep)
 
     def rollout(params, ast, arrays, prios, seq_meta, first):
         ast = _zero_deltas(ast)
@@ -561,11 +720,20 @@ def make_anakin_rollout(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
             length=steps)
         return ast, arrays, prios, seq_meta, first, _stats_vec(ast)
 
-    return jax.jit(RETRACES.wrap("learner.anakin_rollout", rollout),
+    wrapped = RETRACES.wrap("learner.anakin_rollout", rollout)
+    if table is None:
+        return jax.jit(wrapped, donate_argnums=(1, 2, 3, 4, 5))
+    st_sh, ast_sh, ring_sh, pr_sh, sm_sh, fb_sh = _anakin_shardings(
+        table, state_template, ast_template, layout)
+    return jax.jit(wrapped,
+                   in_shardings=(st_sh.params, ast_sh, ring_sh, pr_sh,
+                                 sm_sh, fb_sh),
+                   out_shardings=(ast_sh, ring_sh, pr_sh, sm_sh, fb_sh,
+                                  table.replicated()),
                    donate_argnums=(1, 2, 3, 4, 5))
 
 
-def make_debug_rollout(cfg: Config, net: R2D2Network, env: AnakinFakeEnv,
+def make_debug_rollout(cfg: Config, net: R2D2Network, env: Any,
                        action_dim: int, steps: int, cut_cond: bool = True):
     """Parity-test harness: like :func:`make_anakin_rollout` but keeps the
     per-step trace (q, hidden, actions, rewards, cut masks, observations)
@@ -594,7 +762,7 @@ class AnakinPlane:
     """Owns the fused loop's device state and its dispatch/harvest cycle.
 
     The host's entire job: dispatch the compiled program, read back the
-    (k + 5)-float result vector, and keep Python-int mirrors of the
+    small flat result vector, and keep Python-int mirrors of the
     counters (no on-device counter can overflow that way).  Every
     device→host crossing ticks ``HOST_TRANSFERS`` (``anakin.result_fetch``
     once per dispatch; ``anakin.snapshot_fetch`` per full-state snapshot)
@@ -604,10 +772,19 @@ class AnakinPlane:
     program donates them and the plane stores the returned generation back
     after every dispatch, so the ring object stays the single owner (same
     handle discipline as the ``in_graph_per`` drivetrain).
+
+    ``table`` (a :class:`~r2d2_tpu.parallel.sharding.ShardingTable`, with
+    ``state_template`` = the run's TrainState or its avals) makes the
+    plane mesh-native: the carry/ring/PER state places per the table, the
+    compiled programs are the sharded entry points, and the snapshot path
+    stays LAYOUT-FREE (``write_state`` host-gathers, ``read_state``
+    re-places under the CURRENT table — a dp=2 snapshot resumes on a
+    dp=1 mesh and vice versa, the checkpoint-resharding contract).
     """
 
     def __init__(self, cfg: Config, net: R2D2Network, action_dim: int,
-                 ring: Any, start_env_steps: int = 0):
+                 ring: Any, start_env_steps: int = 0, table=None,
+                 state_template=None):
         if not getattr(cfg, "in_graph_per", False):
             raise ValueError("the anakin plane requires in_graph_per=True "
                              "(train._train_anakin flips it on)")
@@ -631,10 +808,11 @@ class AnakinPlane:
         # flat result vector carries the per-inner-step diagnostic rows;
         # train._train_anakin attaches the run's LearnHealthMonitor
         self._lh = getattr(cfg, "learnhealth_interval", 0) > 0
+        self._eval = cfg.anakin_eval_interval > 0
         self.monitor = None
-        self.env = AnakinFakeEnv(
-            obs_shape=cfg.stored_obs_shape, action_dim=action_dim,
-            episode_len=cfg.anakin_episode_len, num_lanes=cfg.num_actors)
+        self.table = table
+        self._layout = getattr(ring, "layout", "replicated")
+        self.env = make_anakin_env(cfg, action_dim)
         # double fold_in: the PER sampling stream is the SINGLE-fold
         # fold_in(PRNGKey(seed), dispatch_idx) over the full u32 range
         # (learner/step.py), so a single-fold plane root would collide
@@ -645,11 +823,27 @@ class AnakinPlane:
             jax.random.fold_in(
                 jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0x414B),
                 1))
-        self.super_step = make_anakin_super_step(cfg, net, self.env,
-                                                 action_dim)
+        self._ast_sh = self._ring_sh = self._per_sh = None
+        if table is not None:
+            # mesh mode: place the carry per the table and compile the
+            # sharded entry points.  The lane axis falls back to
+            # replication via the table's divisibility guard when
+            # num_actors does not divide dp — semantics identical either
+            # way, the layout is a pure perf choice.
+            self._ast_sh = table.anakin_state_shardings(self.state,
+                                                        self._layout)
+            self._ring_sh = table.ring_shardings(self._layout)
+            self._per_sh = table.per_shardings(self._layout)
+            self.state = jax.device_put(self.state, self._ast_sh)
+        self.super_step = make_anakin_super_step(
+            cfg, net, self.env, action_dim, table=table,
+            state_template=state_template, ast_template=self.state,
+            layout=self._layout)
         self.roll_steps = cfg.superstep_k * cfg.anakin_env_steps_per_update
-        self.rollout = make_anakin_rollout(cfg, net, self.env, action_dim,
-                                           steps=self.roll_steps)
+        self.rollout = make_anakin_rollout(
+            cfg, net, self.env, action_dim, steps=self.roll_steps,
+            table=table, state_template=state_template,
+            ast_template=self.state, layout=self._layout)
         self._frames_per_dispatch = self.roll_steps * cfg.num_actors
 
         # host-int counter mirrors (absolute; deltas arrive per dispatch).
@@ -666,11 +860,18 @@ class AnakinPlane:
         self.reward_total = 0.0
         self.training_steps = 0
         self.dispatch_no = 0
+        # in-graph greedy eval lane (cfg.anakin_eval_interval): totals
+        # accumulate across resumes, last_eval_return is the most recent
+        # dispatch's mean greedy return (the learning-curve gauge)
+        self.eval_episodes_total = 0
+        self.eval_return_total = 0.0
+        self.last_eval_return = float("nan")
         # interval accumulators, reset by stats() (ReplayBuffer.stats
         # semantics so the log loop code is shared-shaped)
         self._interval_episodes = 0
         self._interval_reward = 0.0
         self._interval_loss = 0.0
+        self._interval_eval_episodes = 0
 
     # ----------------------------------------------------------- dispatch
     def _handles(self):
@@ -723,14 +924,25 @@ class AnakinPlane:
         k = self.cfg.superstep_k
         losses = v[:k]
         stats = v[k:k + len(STATS_FIELDS)]
+        off = k + len(STATS_FIELDS)
+        if self._eval:
+            # the eval lane's [episodes, return_sum] pair rides the same
+            # vector; zeros on off-cadence dispatches
+            ep, rsum = float(v[off]), float(v[off + 1])
+            off += len(EVAL_FIELDS)
+            if ep > 0:
+                with self._stats_lock:
+                    self.eval_episodes_total += int(ep)
+                    self.eval_return_total += rsum
+                    self.last_eval_return = rsum / ep
+                    self._interval_eval_episodes += int(ep)
         if self.monitor is not None:
             # the monitor owns non-finite handling (trips a clean fabric
             # stop + the nonfinite alert) and absorbs the diag rows the
             # fused program appended to the same flat vector
             self.monitor.note_losses(losses)
             if self._lh:
-                self.monitor.absorb_diags(
-                    v[k + len(STATS_FIELDS):].reshape(k, -1))
+                self.monitor.absorb_diags(v[off:].reshape(k, -1))
         else:
             assert np.isfinite(losses).all(), (
                 f"non-finite loss in anakin super-step: {losses}")
@@ -766,16 +978,21 @@ class AnakinPlane:
                        sum_loss=self._interval_loss,
                        frames=self.frames, super_steps=self.super_steps,
                        blocks=self.blocks,
-                       episodes_total=self.episodes_total)
+                       episodes_total=self.episodes_total,
+                       eval_episodes=self.eval_episodes_total,
+                       interval_eval_episodes=self._interval_eval_episodes,
+                       eval_return=self.last_eval_return)
             self._interval_episodes = 0
             self._interval_reward = 0.0
             self._interval_loss = 0.0
+            self._interval_eval_episodes = 0
         return out
 
     # ----------------------------------------------------------- snapshot
     _COUNTER_FIELDS = ("env_steps", "fill", "frames", "super_steps",
                        "blocks", "episodes_total", "reward_total",
-                       "training_steps", "dispatch_no")
+                       "training_steps", "dispatch_no",
+                       "eval_episodes_total", "eval_return_total")
 
     def _payload(self) -> Dict[str, np.ndarray]:
         """Host copies of the ENTIRE on-device loop state: anakin carry
@@ -815,7 +1032,10 @@ class AnakinPlane:
     def read_state(self, path: str, meta: Dict[str, Any]) -> None:
         """Restore the state :meth:`write_state` captured.  Raises
         ``ValueError`` on a geometry/config mismatch (the caller warns and
-        resumes cold)."""
+        resumes cold).  The snapshot is LAYOUT-FREE (host-gathered
+        global arrays), so it restores under ANY mesh shape — each array
+        is re-placed per the CURRENT table here, the same resharding
+        contract as learner checkpoints (docs/SHARDING.md)."""
         if meta.get("kind") != "anakin":
             raise ValueError("snapshot is not an anakin loop snapshot")
         with np.load(path) as z:
@@ -828,14 +1048,29 @@ class AnakinPlane:
             raise ValueError(
                 "anakin snapshot layout mismatch — written under a "
                 "different config geometry; resuming cold")
-        self.state = {k[len("state_"):]: jnp.asarray(v)
-                      for k, v in flat.items() if k.startswith("state_")}
-        self.ring.arrays = {k[len("ring_"):]: jnp.asarray(v)
-                            for k, v in flat.items()
-                            if k.startswith("ring_")}
-        self.ring.put_prios(jnp.asarray(flat["per_prios"]))
-        self.ring.put_per_meta(jnp.asarray(flat["per_seq_meta"]),
-                               jnp.asarray(flat["per_first"]))
+
+        def place(v, sh):
+            return (jax.device_put(v, sh) if sh is not None
+                    else jnp.asarray(v))
+
+        self.state = {
+            k[len("state_"):]: place(
+                v, None if self._ast_sh is None
+                else self._ast_sh[k[len("state_"):]])
+            for k, v in flat.items() if k.startswith("state_")}
+        self.ring.arrays = {
+            k[len("ring_"):]: place(
+                v, None if self._ring_sh is None
+                else self._ring_sh[k[len("ring_"):]])
+            for k, v in flat.items() if k.startswith("ring_")}
+        per = self._per_sh
+        self.ring.put_prios(place(flat["per_prios"],
+                                  None if per is None else per["prios"]))
+        self.ring.put_per_meta(
+            place(flat["per_seq_meta"],
+                  None if per is None else per["seq_meta"]),
+            place(flat["per_first"],
+                  None if per is None else per["first"]))
         c = meta.get("counters", {})
         for k in self._COUNTER_FIELDS:
             if k in c:
@@ -1081,4 +1316,9 @@ def run_anakin_loop(learner: Any, plane: AnakinPlane,
     metrics["mean_episode_return"] = (
         plane.reward_total / plane.episodes_total
         if plane.episodes_total else float("nan"))
+    # in-graph greedy eval lane totals (cfg.anakin_eval_interval)
+    metrics["eval_episodes"] = plane.eval_episodes_total
+    metrics["mean_eval_return"] = (
+        plane.eval_return_total / plane.eval_episodes_total
+        if plane.eval_episodes_total else float("nan"))
     return metrics

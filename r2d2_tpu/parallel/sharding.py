@@ -101,6 +101,13 @@ DEFAULT_TABLE: Dict[str, Tuple[Optional[str], ...]] = {
     # the ring layout asks for it (DeviceRing consumes these entries)
     "ring.*": ("dp",),
     "per.*": ("dp",),
+    # anakin fused loop (learner/anakin.py): per-lane carry arrays — env
+    # state, agent obs/LSTM carry, local stream buffers — shard their
+    # lane axis over dp (the Podracer replicate-the-program axis);
+    # anakin_state_shardings resolves every lane-batched leaf through
+    # this one entry (scalars/fleet-wide RNG keys replicate, the ring
+    # slot-axis accounting follows the ring.* entries)
+    "anakin.lane.*": ("dp",),
 }
 
 def _path_token(entry: Any) -> str:
@@ -246,6 +253,30 @@ class ShardingTable:
             return {k: self.replicated() for k in PER_KEYS}
         return {k: NamedSharding(self.mesh, self.spec(("per", k)))
                 for k in PER_KEYS}
+
+    def anakin_state_shardings(self, ast, layout: str = "replicated"
+                               ) -> Dict[str, Any]:
+        """NamedShardings for the anakin fused loop's carry dict
+        (learner/anakin.py ``make_anakin_state``): per-lane arrays
+        resolve through the table's ``anakin.lane.*`` entry (lane axis
+        over dp, with the divisibility guard's replication fallback),
+        the ring-slot-axis accounting (``block_learning_total``) follows
+        the ``ring.*`` entries under a ``"dp"`` ring layout, and
+        scalars / the fleet-wide exploration key replicate.  ``ast`` may
+        hold live arrays or ShapeDtypeStructs."""
+        out: Dict[str, Any] = {}
+        for k, v in ast.items():
+            shape = tuple(np.shape(v))
+            if k == "block_learning_total":
+                out[k] = (NamedSharding(self.mesh,
+                                        self.spec(("ring", k), shape))
+                          if layout == "dp" else self.replicated())
+            elif k == "act_key" or len(shape) == 0:
+                out[k] = self.replicated()
+            else:
+                out[k] = NamedSharding(
+                    self.mesh, self.spec(("anakin", "lane", k), shape))
+        return out
 
     def place_state(self, state):
         """Place a host/any-layout TrainState onto the mesh with the

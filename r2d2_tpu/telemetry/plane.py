@@ -23,6 +23,7 @@ than one interval.
 """
 from __future__ import annotations
 
+import math
 import os
 from typing import Any, Dict, Optional
 
@@ -289,6 +290,15 @@ class Telemetry:
             reg.counter_max("actor.blocks_produced", an.get("blocks", 0))
             reg.counter_max("actor.episodes", an.get("episodes_total", 0))
             reg.set_gauge("anakin.ring_fill", entry.get("buffer_size", 0))
+            # in-graph greedy eval lane (cfg.anakin_eval_interval): the
+            # return gauge stays absent until the first eval dispatch
+            # (last_eval_return is NaN before it — a NaN gauge would
+            # poison /metrics parsers)
+            reg.counter_max("anakin.eval_episodes",
+                            an.get("eval_episodes", 0))
+            ev = an.get("eval_return")
+            if ev is not None and math.isfinite(ev):
+                reg.set_gauge("anakin.eval_return", ev)
         # learning-health plane (telemetry/learnhealth.py): the
         # monitor's snapshot — latest armed in-graph diag scalars as
         # gauges, cumulative sentry/spike counters, and the |TD| /

@@ -729,24 +729,20 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
     four-method surface).
     """
     from r2d2_tpu.learner.anakin import AnakinPlane, run_anakin_loop
-    from r2d2_tpu.replay.device_ring import DeviceRing
+    from r2d2_tpu.replay.device_ring import DeviceRing, resolve_layout
 
-    if use_mesh:
-        import warnings
-
-        warnings.warn("anakin transport is single-device (v1); --mesh is "
-                      "ignored", stacklevel=2)
     if cfg.game_name != "Fake":
         import warnings
 
         warnings.warn(
             f"anakin transport needs a jittable env; substituting the "
-            f"pure-JAX fake env for {cfg.game_name!r}", stacklevel=2)
+            f"pure-JAX {cfg.anakin_env!r} env for {cfg.game_name!r} "
+            "(cfg.anakin_env selects it)", stacklevel=2)
     # the fused program IS device replay with in-graph PER — flip the
     # flags so the ring/PER state and the train-step composition build
     # exactly as the in_graph_per drivetrain's (effective-config pattern)
     cfg = cfg.replace(device_replay=True, in_graph_per=True)
-    action_dim = 4  # the anakin fake env's action set (envs/anakin.py)
+    action_dim = 4  # both anakin envs' action set (envs/anakin.py)
     net = create_network(cfg, action_dim)
     params = init_params(cfg, net, jax.random.PRNGKey(cfg.seed))
     state = create_train_state(cfg, params)
@@ -762,16 +758,35 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
         start_env_steps = int(meta.get("env_steps", 0))
         start_minutes = float(meta.get("minutes", 0.0))
 
-    ring = DeviceRing(cfg, action_dim)
+    # multi-chip anakin (ROADMAP item 2): under --mesh the fused program
+    # compiles through the ONE table-driven sharded entry point — lanes,
+    # carry and local buffers over dp, params/moments per the table,
+    # ring/PER per the resolved ring layout (the Podracer
+    # replicate-the-fused-program scale-out).  Without --mesh the
+    # single-device path is unchanged.
+    mesh = make_mesh(cfg) if use_mesh else None
+    table = None
+    if mesh is not None:
+        from r2d2_tpu.parallel.sharding import ShardingTable
+        from r2d2_tpu.replay.replay_buffer import data_bytes
+
+        table = ShardingTable(mesh, cfg)
+        layout = resolve_layout(cfg, mesh, data_bytes(cfg, action_dim),
+                                _device_memory_bytes())
+        ring = DeviceRing(cfg, action_dim, table=table, layout=layout)
+    else:
+        ring = DeviceRing(cfg, action_dim)
     # no ParamStore: the fused loop acts on the CURRENT params in-graph
     # and nothing else consumes published snapshots in this mode (no
     # fleets, pump, or inference service) — publishing would just run a
     # jitted whole-tree param copy per cadence for no reader
-    learner = Learner(cfg, net, state, checkpointer=checkpointer,
+    learner = Learner(cfg, net, state, mesh=mesh, table=table,
+                      checkpointer=checkpointer,
                       start_env_steps=start_env_steps,
                       start_minutes=start_minutes)
     plane = AnakinPlane(cfg, net, action_dim, ring,
-                        start_env_steps=start_env_steps)
+                        start_env_steps=start_env_steps, table=table,
+                        state_template=learner.state)
 
     restored_anakin = False
     if checkpointer is not None and resume:
@@ -864,7 +879,12 @@ def _train_anakin(cfg: Config, checkpoint_dir: Optional[str] = None,
                             frames=s["frames"],
                             frames_per_sec=(s["frames"] - last_frames) / dt,
                             blocks=s["blocks"],
-                            episodes_total=s["episodes_total"]),
+                            episodes_total=s["episodes_total"],
+                            # in-graph greedy eval lane
+                            # (cfg.anakin_eval_interval): the learning
+                            # curve without a host env
+                            eval_episodes=s["eval_episodes"],
+                            eval_return=s["eval_return"]),
             )
             # learnhealth + alerts: the anakin PER leaves live in-graph
             # (no host tree to walk), so no replay data-health here —
@@ -1010,13 +1030,17 @@ def train(cfg: Config, env_factory: EnvFactory = _default_env_factory,
         # actor, replay and learner are ONE jitted program — none of the
         # thread/process fabric below applies
         if env_factory is not _default_env_factory:
-            import warnings
-
-            warnings.warn(
-                "anakin transport ignores env_factory — the env must be "
-                "jittable and v1 ships only the pure-JAX fake env "
-                "(envs/anakin.py; episode length via "
-                "cfg.anakin_episode_len)", stacklevel=2)
+            # hard error, not a warning: with two jittable envs behind
+            # cfg.anakin_env a custom factory here is a config mistake a
+            # silent fallback would hide — host env factories cannot run
+            # inside the fused program
+            raise ValueError(
+                "anakin transport cannot run a host env_factory — the "
+                "env must be jnp ops.  Select a jittable env with "
+                "cfg.anakin_env ('fake' or 'grid'), or implement the "
+                "envs/anakin.py four-method surface "
+                "(init_state/observe/step/reset_lanes + STATE_KEYS) and "
+                "register it in make_anakin_env")
         if cfg.league_eval:
             import warnings
 

@@ -130,6 +130,71 @@ def test_anakin_env_bit_exact_vs_numpy_oracle():
                                                   oracles[lane]._obs())
 
 
+def test_anakin_grid_env_bit_exact_vs_numpy_oracle():
+    """The second jittable env (ISSUE 15): the gridworld twin is
+    step-for-step bit-exact against the numpy GridWorldEnv oracle across
+    episode boundaries — obs bytes, rewards, truncation flags.  Reset
+    agent/goal draws come from the anakin env's per-lane streams and are
+    replayed into the oracle through its resumable-state surface (the
+    RNG source is the one documented divergence; in-episode dynamics are
+    fully deterministic, so the replay covers whole episodes)."""
+    from r2d2_tpu.envs import GridWorldEnv
+    from r2d2_tpu.envs.anakin import AnakinGridEnv
+
+    N, ep_len = 3, 6
+    env = AnakinGridEnv(obs_shape=(12, 12, 1), action_dim=A,
+                        episode_len=ep_len, num_lanes=N)
+    st = env.init_state(jax.random.PRNGKey(7))
+    step = jax.jit(env.step)
+    reset_lanes = jax.jit(env.reset_lanes)
+
+    def force(oracle, lane_state, lane):
+        oracle.reset()
+        oracle.restore_state(dict(
+            rng=oracle._rng.bit_generator.state,
+            agent=int(lane_state["agent"][lane]),
+            goal=int(lane_state["goal"][lane]), t=0))
+
+    oracles = []
+    for lane in range(N):
+        o = GridWorldEnv(obs_shape=(12, 12, 1), action_dim=A,
+                         episode_len=ep_len, seed=lane)
+        force(o, st, lane)
+        np.testing.assert_array_equal(np.asarray(env.observe(st)[lane]),
+                                      o._obs())
+        oracles.append(o)
+
+    rng = np.random.default_rng(1)
+    for t in range(3 * ep_len + 2):
+        actions = rng.integers(0, A, size=N)
+        st, reward, trunc = step(st, jax.numpy.asarray(actions))
+        obs = np.asarray(env.observe(st))
+        for lane in range(N):
+            oo, orr, oterm, otr, _ = oracles[lane].step(int(actions[lane]))
+            np.testing.assert_array_equal(obs[lane], oo)
+            assert float(reward[lane]) == orr  # f32-exact: {0, 1}
+            assert bool(trunc[lane]) == otr and not oterm
+        if bool(trunc.any()):
+            st = reset_lanes(st, trunc)
+            obs = np.asarray(env.observe(st))
+            for lane in range(N):
+                if bool(trunc[lane]):
+                    force(oracles[lane], st, lane)
+                    np.testing.assert_array_equal(obs[lane],
+                                                  oracles[lane]._obs())
+    # the host mirror of one reset draw matches the in-graph one
+    k0 = np.asarray(jax.random.PRNGKey(5), np.uint32)
+    k1, agent, goal = env.host_reset_draw(k0)
+    st1 = env.reset_lanes(
+        dict(agent=jax.numpy.zeros(1, jax.numpy.int32),
+             goal=jax.numpy.ones(1, jax.numpy.int32),
+             t=jax.numpy.zeros(1, jax.numpy.int32),
+             key=jax.numpy.asarray(k0)[None]),
+        jax.numpy.ones(1, bool))
+    assert int(st1["agent"][0]) == agent and int(st1["goal"][0]) == goal
+    np.testing.assert_array_equal(np.asarray(st1["key"][0]), k1)
+
+
 # ------------------------------------------------------------ block parity
 
 @pytest.mark.parametrize("mode", ["burn_in_start", "seq_start"])
@@ -333,6 +398,45 @@ def test_anakin_trains_and_policy_beats_random():
     early = rets[0][1]
     late = rets[-1][1]
     assert late > early, (early, late)
+
+
+@pytest.mark.slow
+def test_anakin_grid_trains_and_policy_beats_random():
+    """The "fast path for free" acceptance run (ISSUE 15): the gridworld
+    env through the UNCHANGED fused program learns a goal-seeking policy
+    that decisively beats random on the NUMPY oracle env, and the
+    in-graph eval lane's greedy curve (no host env) improves over the
+    run."""
+    from r2d2_tpu.envs import GridWorldEnv
+    from r2d2_tpu.evaluate import evaluate_params
+
+    cfg = anakin_config(training_steps=6000, superstep_k=4, num_actors=4,
+                        anakin_episode_len=32, anakin_env="grid",
+                        anakin_eval_interval=100, learning_starts=32,
+                        gamma=0.95, lr=3e-4, buffer_capacity=320,
+                        log_interval=2.0)
+    m = train(cfg, verbose=False, max_wall_seconds=600)
+    assert m["num_updates"] >= 6000
+    assert np.isfinite(np.asarray(m["losses"])).all()
+
+    def env_factory(c, seed):
+        return GridWorldEnv(obs_shape=c.obs_shape, action_dim=A, seed=seed,
+                            episode_len=c.anakin_episode_len)
+
+    net = create_network(cfg, A)
+    params0 = init_params(cfg, net, jax.random.PRNGKey(3))
+    rand_score = evaluate_params(cfg, net, params0, env_factory,
+                                 episodes=5, epsilon=1.0, seed=11)
+    score = evaluate_params(cfg, net, m["final_params"], env_factory,
+                            episodes=5, epsilon=cfg.test_epsilon, seed=11)
+    assert score > rand_score + 2.0, (score, rand_score)
+    # the eval LANE saw the same improvement without any host env
+    assert m["eval_episodes"] > 0
+    evals = [e["anakin"]["eval_return"] for e in m["logs"]
+             if e["anakin"]["eval_episodes"] > 0
+             and np.isfinite(e["anakin"]["eval_return"])]
+    assert len(evals) >= 3
+    assert max(evals[len(evals) // 2:]) > evals[0] + 2.0, evals
 
 
 # --------------------------------------------------------------- recovery

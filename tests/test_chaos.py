@@ -250,6 +250,10 @@ def test_chaos_kill_fleet_respawned_on_shard():
     assert all(p is None or not p.is_alive() for p in plane.procs)
 
 
+# slow: historically the suite's load-flakiest drill (r05/r07 deflakes);
+# the shard-reset claim stays pinned by the inference-service unit
+# tests and the serve soak rounds (ISSUE 15 wall-budget rebalance).
+@pytest.mark.slow
 @pytest.mark.timeout(600)
 def test_chaos_kill_fleet_serve_zeroes_server_hidden():
     """Serve-mode recovery drill (ISSUE 3): chaos SIGKILLs a serve-mode

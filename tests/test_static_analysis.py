@@ -174,6 +174,53 @@ def test_jit_purity_follows_factory_and_partial_and_wrap():
     assert "np.random.normal" in msgs     # via wrap + intra-module call
 
 
+def test_jit_purity_unions_same_name_assigned_wrappers():
+    """Regression (ISSUE 15 satellite): two sibling factories binding
+    their pre-jit callable to the SAME local name (the sharded anakin
+    entry points' ``wrapped = RETRACES.wrap(...)`` idiom) must BOTH
+    reach the root set — last-wins resolution silently dropped every
+    earlier factory's function graph, so a host clock inside the first
+    factory's program went unseen."""
+    report = analyze_source(_src("""
+        import time
+        import jax
+        from r2d2_tpu.utils.trace import RETRACES
+
+        def make_super_step():
+            def super_step(x):
+                return x + time.time()     # must be flagged
+            wrapped = RETRACES.wrap("super", super_step)
+            return jax.jit(wrapped, donate_argnums=(0,))
+
+        def make_rollout():
+            def rollout(x):
+                return x * 2
+            wrapped = RETRACES.wrap("roll", rollout)
+            return jax.jit(wrapped, donate_argnums=(0,))
+    """), rules=["jit-purity"])
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "time.time" in msgs and "super_step" in msgs
+
+
+def test_jit_purity_rebinding_cycle_terminates():
+    """``fn = RETRACES.wrap("n", fn)`` rebinding must not send the
+    resolver into infinite recursion (the union fix follows every
+    assignment under a name, including self-referential ones)."""
+    report = analyze_source(_src("""
+        import time
+        import jax
+        from r2d2_tpu.utils.trace import RETRACES
+
+        def outer():
+            fn = RETRACES.wrap("n", fn)    # degenerate rebinding
+            return jax.jit(fn)
+
+        def host():
+            return time.time()
+    """), rules=["jit-purity"])
+    assert report.findings == []
+
+
 def test_jit_purity_flags_mutable_default_and_device_get():
     report = analyze_source(_src("""
         import jax

@@ -231,6 +231,28 @@ def test_record_exports_negative_reward_sum_as_gauge_not_counter():
     assert reg.get_gauge("actor.episode_reward_sum") == -50.0
 
 
+def test_record_absorbs_anakin_eval_lane_and_gates_nan_gauge():
+    """The anakin entry's in-graph eval-lane fields (ISSUE 15) land in
+    the registry — `anakin.eval_episodes` as a counter, `eval_return`
+    as a gauge that stays ABSENT while the plane's last_eval_return is
+    still NaN (pre-first-eval): a NaN gauge would poison /metrics
+    parsers."""
+    t = Telemetry(make_test_config())
+    an = dict(super_steps=4, frames=64, frames_per_sec=10.0, blocks=2,
+              episodes_total=1, eval_episodes=0,
+              eval_return=float("nan"))
+    t.record(dict(training_steps=2, env_steps=64, buffer_size=8,
+                  anakin=an))
+    reg = t.registry
+    assert reg.get_counter("anakin.eval_episodes") == 0
+    assert reg.get_gauge("anakin.eval_return") is None
+    an.update(eval_episodes=8, eval_return=17.5)
+    t.record(dict(training_steps=4, env_steps=128, buffer_size=8,
+                  anakin=an))
+    assert reg.get_counter("anakin.eval_episodes") == 8
+    assert reg.get_gauge("anakin.eval_return") == 17.5
+
+
 # --------------------------------------------------------- JSONL run log
 
 def test_runlog_append_resume_and_rotation(tmp_path):
@@ -435,6 +457,11 @@ def test_console_formatting_shared_with_top():
 
 # ------------------------------------------------------ train() e2es
 
+# slow: ~25 s process-transport run on the tier-1 wall budget (ISSUE 15
+# rebalance).  The merge/absorption/exporter claims stay pinned by the
+# unit layer above; every remaining train() e2e exercises registry +
+# JSONL absorption on its own transport.
+@pytest.mark.slow
 @pytest.mark.timeout(600)
 def test_train_e2e_metrics_endpoint_aggregates_fleet_counters(tmp_path):
     """Acceptance: a train() run with telemetry enabled serves /metrics
