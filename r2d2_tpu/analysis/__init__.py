@@ -10,6 +10,12 @@ Rule families (see docs/ANALYSIS.md for the full reference):
 - ``wire-format``          shm slot layout / CRC single-sourced in replay/block
 - ``telemetry-discipline`` metric names are registered literals, not
   f-strings (the variable part belongs in a label)
+- ``donation-discipline``  buffer-donation contracts: no use-after-donate,
+  drivetrain jit sites donate state/batch params, no per-iteration
+  syncs on donated results
+- ``transfer-flow``        implicit device<->host transfers outside jit
+  (numpy casts of jitted results, unsharded device_put in mesh
+  modules, scalarization in *_loop functions)
 
 Importing this package registers every rule.  The analyzer itself is
 pure stdlib ``ast``: the ``r2d2_tpu`` package root does pull in jax at
@@ -31,9 +37,11 @@ from r2d2_tpu.analysis.core import (  # noqa: F401
 from r2d2_tpu.analysis import (  # noqa: F401  (import = rule registration)
     bounded_wait,
     config_integrity,
+    donation,
     jit_purity,
     telemetry_discipline,
     thread_discipline,
+    transfer_flow,
     wire_format,
 )
 

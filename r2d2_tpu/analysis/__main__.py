@@ -3,7 +3,9 @@
 Exit status 0 = clean tree (suppressed findings allowed), 1 = findings
 or unparseable files.  Default paths: ``r2d2_tpu tools`` relative to the
 current directory.  ``--rules a,b`` restricts the run; ``--list-rules``
-prints the registry.
+prints the registry.  ``--baseline FILE`` checks the report against a
+committed findings+suppressions snapshot (exit 1 with a diff on drift);
+``--write-baseline FILE`` regenerates the snapshot.
 """
 from __future__ import annotations
 
@@ -33,6 +35,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="repo root for relative paths + docs lookup "
                         "(default: cwd)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="check findings+suppressions against this "
+                        "snapshot; exit 1 with a diff on drift")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="write the snapshot for --baseline to check")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -49,6 +56,37 @@ def main(argv: Optional[List[str]] = None) -> int:
             p.error(f"unknown rules: {', '.join(unknown)} "
                     f"(have: {', '.join(sorted(RULES))})")
     report = run_analysis(paths, root=args.root, rules=rules)
+
+    if args.write_baseline:
+        from r2d2_tpu.analysis import baseline as bl
+
+        bl.write(args.write_baseline, report)
+        print(f"graftlint: baseline written to {args.write_baseline} "
+              f"({len(report.findings)} finding(s), "
+              f"{len(report.suppressed)} suppression(s))")
+        if report.findings:
+            print("graftlint: WARNING — baselining a DIRTY tree: the "
+                  "findings above are now pinned as accepted debt")
+        return 0 if not report.errors else 1
+
+    if args.baseline:
+        from r2d2_tpu.analysis import baseline as bl
+
+        try:
+            base = bl.load(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graftlint: cannot load baseline: {e}",
+                  file=sys.stderr)
+            return 1
+        problems = bl.diff(base, report)
+        for f in report.errors:
+            print(f.format())
+        for line in problems:
+            print(line)
+        print(f"graftlint: {len(problems)} drift line(s) vs baseline "
+              f"{args.baseline}, {len(report.errors)} parse error(s) "
+              f"across {report.files} files")
+        return 0 if not problems and not report.errors else 1
 
     if args.as_json:
         print(json.dumps(report.to_dict(), indent=1))

@@ -36,7 +36,8 @@ import tokenize
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
-SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,\-]+)")
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([\w,\-]+)(?:\s*--\s*(.+))?")
 
 # rel-path suffixes never analyzed (generated / vendored would go here)
 SKIP_PARTS = ("__pycache__",)
@@ -48,6 +49,9 @@ class Finding:
     path: str          # root-relative, forward slashes
     line: int
     message: str
+    # the "-- reason" text of the matching suppression comment; set only
+    # on suppressed findings (baseline files record it per suppression)
+    reason: Optional[str] = None
 
     def format(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
@@ -69,6 +73,7 @@ class Module:
         # "# graftlint: disable=..." inside a string literal or docstring
         # (e.g. a pasted doc example) must never silence a real finding
         self.suppressions: Dict[int, Set[str]] = {}
+        self.suppress_reasons: Dict[int, str] = {}
         try:
             for tok in tokenize.generate_tokens(
                     io.StringIO(source).readline):
@@ -79,12 +84,18 @@ class Module:
                     self.suppressions[tok.start[0]] = {
                         r.strip() for r in m.group(1).split(",")
                         if r.strip()}
+                    if m.group(2):
+                        self.suppress_reasons[tok.start[0]] = \
+                            m.group(2).strip()
         except tokenize.TokenError:  # ast.parse above accepted it; keep
             pass                     # whatever comments tokenized cleanly
 
     def suppressed(self, rule_name: str, line: int) -> bool:
         rules = self.suppressions.get(line)
         return rules is not None and (rule_name in rules or "all" in rules)
+
+    def suppress_reason(self, line: int) -> Optional[str]:
+        return self.suppress_reasons.get(line)
 
 
 @dataclasses.dataclass
@@ -266,7 +277,8 @@ def run_analysis(paths: Sequence[str], root: Optional[str] = None,
         for f in RULES[name].check(ctx):
             mod = by_rel.get(f.path)
             if mod is not None and mod.suppressed(f.rule, f.line):
-                quiet.append(f)
+                quiet.append(dataclasses.replace(
+                    f, reason=mod.suppress_reason(f.line)))
             else:
                 live.append(f)
     live.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -286,6 +298,10 @@ def analyze_source(source: str, name: str = "fixture.py",
     names = list(rules) if rules is not None else sorted(RULES)
     for rn in names:
         for f in RULES[rn].check(ctx):
-            (quiet if mod.suppressed(f.rule, f.line) else live).append(f)
+            if mod.suppressed(f.rule, f.line):
+                quiet.append(dataclasses.replace(
+                    f, reason=mod.suppress_reason(f.line)))
+            else:
+                live.append(f)
     return Report(findings=live, suppressed=quiet, errors=[], files=1,
                   rules=names)

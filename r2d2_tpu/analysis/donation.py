@@ -1,0 +1,448 @@
+"""donation-discipline: buffer-donation contracts around jit call sites.
+
+Every drivetrain in this repo donates its TrainState and batch buffers
+(``donate_argnums`` on the jit/pjit site — Podracer's in-place update
+discipline, PAPERS.md).  Donation is invisible to the type system and
+*silently forgiving on CPU*: reading a donated buffer after the call
+works on the tier-1 host and crashes only on a real accelerator, which
+is exactly the class of bug a CPU-only CI can never catch at runtime.
+This family makes the contract static:
+
+- ``use-after-donate`` — a caller reads (or mutates) a variable it
+  passed in a donated position *after* the donating call, before any
+  rebinding.  Intra-function dataflow over statement order; the callee
+  set is resolved with the same idioms jit-purity handles (decorator,
+  direct ``jax.jit(fn, donate_argnums=...)`` assignment, factory
+  return, ``RETRACES.wrap`` / ``functools.partial`` chains).
+- ``missed-donation`` — a jit entry point in the drivetrain modules
+  (``learner/``, ``parallel/``, ``envs/anakin.py``) whose wrapped
+  function takes a large-array state/batch parameter (declared name
+  vocabulary below, or a ``TrainState`` annotation) with no
+  ``donate_argnums``/``donate_argnames`` on the site.  A deliberate
+  non-donating site suppresses with a reason (recorded in the
+  graftlint baseline).
+- ``result-sync`` — ``jax.device_get`` / ``np.asarray`` /
+  ``.block_until_ready()`` applied to a donating entry point's result
+  inside a ``*_loop`` function: a per-iteration sync that defeats the
+  async dispatch the donation bought.  Harvest belongs behind the
+  declared ``HOST_TRANSFERS`` sites, not in the loop body.
+
+Messages carry a stable finding code prefix (``use-after-donate:``,
+``missed-donation:``, ``result-sync:``) — docs/ANALYSIS.md documents
+each; the suppression key is the family name ``donation-discipline``.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from r2d2_tpu.analysis.core import Context, Finding, dotted_name, rule
+from r2d2_tpu.analysis.jit_purity import (
+    _JIT_NAMES,
+    _FuncNode,
+    _ModuleIndex,
+)
+
+RULE = "donation-discipline"
+
+# param names that mean "large device-resident state/batch buffer" for
+# the missed-donation heuristic (exact match on the wrapped function's
+# positional params); annotations ending in TrainState also qualify
+_STATE_VOCAB = {
+    "state", "train_state", "ts", "batch", "carry", "ring", "per_state",
+    "opt_state", "buffer_state", "slab", "arrays",
+}
+# rel-path scopes where missed-donation applies (the drivetrains; a
+# serving act fn legitimately never donates its params)
+_DONATE_SCOPES = ("r2d2_tpu/learner/", "r2d2_tpu/parallel/")
+_DONATE_FILES = ("r2d2_tpu/envs/anakin.py",)
+
+_SYNC_CALLS = {"jax.device_get", "np.asarray", "numpy.asarray",
+               "np.array", "numpy.array"}
+
+
+@dataclasses.dataclass
+class _DonateSite:
+    """One jit/pjit call with donation info, bound to a local name."""
+    name: str                 # local/attr name the jit result is bound to
+    argnums: Tuple[int, ...]  # donated positional indices ((), if none)
+    argnames: Tuple[str, ...]
+    line: int
+    donates: bool             # any donate kwarg present at the site
+    # True when `name` is a FACTORY whose *return value* donates — the
+    # argnums apply to calls of the factory's result (bound via the
+    # inheritance pass), never to the factory call itself
+    factory: bool = False
+
+
+def _const_int_tuple(node) -> Tuple[int, ...]:
+    """Literal ints out of ``donate_argnums=(0, 2)`` / ``=0``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _const_str_tuple(node) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _jit_call(node: ast.Call) -> Optional[ast.Call]:
+    """The jit/pjit Call itself if ``node`` is one (following a
+    ``functools.partial(jax.jit, ...)``-style head is not needed: the
+    repo always calls jit directly or via the factory idioms)."""
+    d = dotted_name(node.func)
+    if d in _JIT_NAMES:
+        return node
+    return None
+
+
+def _donation_kwargs(call: ast.Call
+                     ) -> Tuple[Tuple[int, ...], Tuple[str, ...], bool]:
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    present = False
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            present = True
+            nums = _const_int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            present = True
+            names = _const_str_tuple(kw.value)
+    return nums, names, present
+
+
+def _bound_name(target) -> Optional[str]:
+    """`x = ...` -> "x"; `self.attr = ...` / `obj.attr = ...` -> "attr"
+    (attribute matching is by attr name — good enough intra-module)."""
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    """Call-site lookup key mirroring :func:`_bound_name`."""
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def collect_donating_sites(tree: ast.AST) -> Dict[str, _DonateSite]:
+    """name -> donation info, for every ``x = jax.jit(...)`` /
+    ``self.attr = jax.jit(...)`` / ``return jax.jit(...)`` (the latter
+    keyed by the enclosing factory's name, covering the
+    ``step = make_step(...)`` idiom) and every ``@jit``-decorated def.
+    A name bound at multiple sites keeps the union of donated positions
+    and donates only if every site donates (conservative for
+    missed-donation, liberal for use-after-donate)."""
+    sites: Dict[str, _DonateSite] = {}
+
+    def record(name: Optional[str], call: ast.Call,
+               factory: bool = False) -> None:
+        if not name:
+            return
+        nums, argnames, present = _donation_kwargs(call)
+        prev = sites.get(name)
+        if prev is None:
+            sites[name] = _DonateSite(name, nums, argnames,
+                                      call.lineno, present, factory)
+        else:
+            prev.argnums = tuple(sorted(set(prev.argnums) | set(nums)))
+            prev.argnames = tuple(sorted(set(prev.argnames)
+                                         | set(argnames)))
+            prev.donates = prev.donates and present
+            prev.factory = prev.factory and factory
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            if isinstance(node.value, ast.Call):
+                call = _jit_call(node.value)
+                if call is not None:
+                    record(_bound_name(node.targets[0]), call)
+        elif isinstance(node, _FuncNode):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    call = _jit_call(dec)
+                    if call is not None:
+                        record(node.name, call)
+            # factory: `def make_step(...): ... return jax.jit(f, ...)`
+            # — the *factory result* is the donating callable, and call
+            # sites bind it as `step = make_step(...)`; key the site by
+            # the factory name (factory=True: the argnums never apply
+            # to the factory call itself) and resolve at the binding
+            for inner in ast.walk(node):
+                if (isinstance(inner, ast.Return)
+                        and isinstance(inner.value, ast.Call)):
+                    call = _jit_call(inner.value)
+                    if call is not None:
+                        record(node.name, call, factory=True)
+
+    # second pass: `def make_step(): ...; step = jit(f, donate...);
+    # return step` — a factory returning a local that holds the jit
+    # result hands the donation info to the factory name
+    for node in ast.walk(tree):
+        if isinstance(node, _FuncNode) and node.name not in sites:
+            for inner in ast.walk(node):
+                if (isinstance(inner, ast.Return)
+                        and isinstance(inner.value, ast.Name)
+                        and inner.value.id in sites
+                        and not sites[inner.value.id].factory):
+                    src = sites[inner.value.id]
+                    sites[node.name] = _DonateSite(
+                        node.name, src.argnums, src.argnames,
+                        node.lineno, src.donates, factory=True)
+                    break
+
+    # third pass: `step = make_step(...)` / `self._fn = make_step(...)`
+    # binds the factory's RESULT — the donation info applies to calls
+    # of the bound name (factory=False from here on)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)):
+            callee = _callee_name(node.value)
+            bound = _bound_name(node.targets[0])
+            if (callee in sites and sites[callee].factory
+                    and bound and bound not in sites):
+                src = sites[callee]
+                sites[bound] = _DonateSite(bound, src.argnums,
+                                           src.argnames, node.lineno,
+                                           src.donates)
+    return sites
+
+
+def _donated_args(call: ast.Call, site: _DonateSite) -> List[ast.Name]:
+    out = []
+    for i in site.argnums:
+        if i < len(call.args) and isinstance(call.args[i], ast.Name):
+            out.append(call.args[i])
+    for kw in call.keywords:
+        if kw.arg in site.argnames and isinstance(kw.value, ast.Name):
+            out.append(kw.value)
+    return out
+
+
+def _check_use_after_donate(rel: str, fn: ast.AST,
+                            sites: Dict[str, _DonateSite],
+                            out: List[Finding],
+                            seen: Set[Tuple[int, str]]) -> None:
+    # (var, call first line, call last line, callee) — a multi-line call
+    # puts argument loads on lines below its lineno; anything inside the
+    # call's own span is the donation itself, not a use-after
+    donations: List[Tuple[str, int, int, str]] = []
+    loads: List[Tuple[str, int]] = []
+    stores: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = _callee_name(node)
+            site = sites.get(callee) if callee else None
+            if site is not None and site.donates and not site.factory:
+                end = getattr(node, "end_lineno", node.lineno) or node.lineno
+                for arg in _donated_args(node, site):
+                    donations.append((arg.id, node.lineno, end, callee))
+        elif isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loads.append((node.id, node.lineno))
+            elif isinstance(node.ctx, (ast.Store, ast.Del)):
+                stores.append((node.id, node.lineno))
+        elif isinstance(node, ast.Assign):
+            # `x, y = f(x, ...)` spanning lines puts the target Store a
+            # line ABOVE the donating call — also book the rebinding at
+            # the value's line so it counts as after-the-call
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if (isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Store)):
+                        stores.append((n.id, node.value.lineno))
+
+    def emit(line: int, msg: str) -> None:
+        key = (line, msg)
+        if key not in seen:
+            seen.add(key)
+            out.append(Finding(RULE, rel, line, msg))
+
+    if not donations:
+        return
+    for var, call_line, call_end, callee in donations:
+        rebind = [ln for v, ln in stores if v == var and ln >= call_line]
+        horizon = min(rebind) if rebind else None
+        for v, ln in loads:
+            if v != var or ln <= call_end:
+                continue
+            if horizon is not None and ln >= horizon:
+                continue
+            emit(ln,
+                 f"use-after-donate: {var!r} was passed in a donated "
+                 f"position of {callee}() at line {call_line} and is "
+                 f"read afterwards — the buffer is invalid on a real "
+                 f"accelerator (CPU silently aliases it)")
+
+    # loop-carried donation: a donating call inside a for/while whose
+    # donated arg is never rebound in the loop body re-reads an
+    # invalidated buffer on the SECOND iteration — the textual
+    # load-before-call ordering above cannot see it
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        body_stores = {n.id for stmt in node.body
+                       for n in ast.walk(stmt)
+                       if isinstance(n, ast.Name)
+                       and isinstance(n.ctx, ast.Store)}
+        for stmt in node.body:
+            for inner in ast.walk(stmt):
+                if not isinstance(inner, ast.Call):
+                    continue
+                callee = _callee_name(inner)
+                site = sites.get(callee) if callee else None
+                if site is None or not site.donates or site.factory:
+                    continue
+                for arg in _donated_args(inner, site):
+                    if arg.id not in body_stores:
+                        emit(inner.lineno,
+                             f"use-after-donate: {arg.id!r} is donated "
+                             f"to {callee}() inside a loop without "
+                             f"being rebound — iteration 2 passes an "
+                             f"already-donated buffer")
+
+
+def _in_donation_scope(rel: str) -> bool:
+    return rel.startswith(_DONATE_SCOPES) or rel in _DONATE_FILES
+
+
+def _wrapped_params(index: _ModuleIndex, call: ast.Call) -> List[Tuple[str, Optional[str]]]:
+    """(param name, annotation dotted name) of the function a jit call
+    wraps, via jit-purity's resolver (decorator/partial/wrap/factory)."""
+    params: List[Tuple[str, Optional[str]]] = []
+    if not call.args:
+        return params
+    for fn in index._resolve_seed(call.args[0]):
+        args = getattr(fn, "args", None)
+        if args is None:
+            continue
+        for a in args.args:
+            ann = dotted_name(a.annotation) if a.annotation else None
+            params.append((a.arg, ann))
+    return params
+
+
+def _looks_like_state(params: List[Tuple[str, Optional[str]]]) -> List[str]:
+    hits = []
+    for name, ann in params:
+        if name in _STATE_VOCAB or (ann or "").endswith("TrainState"):
+            hits.append(name)
+    return hits
+
+
+def _check_missed_donation(rel: str, tree: ast.AST, index: _ModuleIndex,
+                           out: List[Finding]) -> None:
+    if not _in_donation_scope(rel):
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            call = _jit_call(node)
+            if call is None:
+                continue
+            _nums, _names, present = _donation_kwargs(call)
+            if present:
+                continue
+            hits = _looks_like_state(_wrapped_params(index, call))
+            if hits:
+                out.append(Finding(
+                    RULE, rel, call.lineno,
+                    f"missed-donation: jit site wraps a function taking "
+                    f"large-array state param(s) {', '.join(sorted(set(hits)))} "
+                    f"with no donate_argnums/donate_argnames — the "
+                    f"drivetrain double-buffers every step"))
+        elif isinstance(node, _FuncNode):
+            for dec in node.decorator_list:
+                if dotted_name(dec) in _JIT_NAMES:
+                    # bare `@jax.jit` decorator: no kwargs possible
+                    hits = _looks_like_state(
+                        [(a.arg, dotted_name(a.annotation)
+                          if a.annotation else None)
+                         for a in node.args.args])
+                    if hits:
+                        out.append(Finding(
+                            RULE, rel, dec.lineno,
+                            f"missed-donation: @jit-decorated "
+                            f"{node.name!r} takes large-array state "
+                            f"param(s) "
+                            f"{', '.join(sorted(set(hits)))} with no "
+                            f"donation — use jax.jit(fn, "
+                            f"donate_argnums=...) at a call site"))
+
+
+def _check_result_sync(rel: str, fn: ast.AST,
+                       sites: Dict[str, _DonateSite],
+                       out: List[Finding]) -> None:
+    if not getattr(fn, "name", "").endswith("_loop"):
+        return
+    results: Set[str] = set()
+    order: List[ast.AST] = list(ast.walk(fn))
+    for node in order:
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            callee = _callee_name(node.value)
+            site = sites.get(callee) if callee else None
+            if site is not None and site.donates and not site.factory:
+                for t in node.targets:
+                    for el in (t.elts if isinstance(t, (ast.Tuple,
+                                                        ast.List))
+                               else [t]):
+                        if isinstance(el, ast.Name):
+                            results.add(el.id)
+    if not results:
+        return
+    for node in order:
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func) or ""
+        target: Optional[str] = None
+        if d in _SYNC_CALLS and node.args and isinstance(node.args[0],
+                                                        ast.Name):
+            target = node.args[0].id
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "block_until_ready"
+              and isinstance(node.func.value, ast.Name)):
+            target = node.func.value.id
+            d = ".block_until_ready"
+        if target in results:
+            out.append(Finding(
+                RULE, rel, node.lineno,
+                f"result-sync: {d}({target}) inside loop function "
+                f"{fn.name!r} forces a per-iteration device sync on a "
+                f"donating entry point's result — harvest behind the "
+                f"declared HOST_TRANSFERS site instead"))
+
+
+@rule(RULE, "buffer-donation contracts: no use-after-donate, drivetrain "
+            "jit sites donate their state/batch params, no per-iteration "
+            "syncs on donated results in *_loop functions")
+def check_donation(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        sites = collect_donating_sites(mod.tree)
+        index = _ModuleIndex(mod.tree)
+        _check_missed_donation(mod.rel, mod.tree, index, findings)
+        seen: Set[Tuple[int, str]] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, _FuncNode):
+                _check_use_after_donate(mod.rel, node, sites, findings,
+                                        seen)
+                _check_result_sync(mod.rel, node, sites, findings)
+    return findings

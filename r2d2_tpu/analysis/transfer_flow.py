@@ -1,0 +1,193 @@
+"""transfer-flow: implicit device↔host transfers outside jit.
+
+jit-purity polices host effects *inside* the traced graph; this family
+covers the other side of the boundary — host code that moves device
+buffers implicitly, which is exactly what the runtime
+``TRANSFER_GUARD`` windows (utils/trace.py) reject when armed.  The
+declared-transfer budget (one H2D per dispatch, one D2H per harvest)
+only holds if every crossing is explicit and intentional:
+
+- ``implicit-transfer`` — ``np.asarray``/``np.array`` applied to the
+  result of a jitted callable (directly, or via a name bound from its
+  call).  A numpy cast of a device array is an implicit synchronous
+  D2H; the declared sites use explicit ``jax.device_get`` (one fetch,
+  guard-exempt under ``transfer_guard("disallow")``) inside a
+  ``HOST_TRANSFERS.allowed(...)`` span.
+- ``unsharded-device-put`` — ``jax.device_put(x)`` with no sharding /
+  device argument in the mesh-aware modules (``parallel/``,
+  ``learner/``): the buffer lands wherever jax's default device points,
+  which on a multi-device mesh silently un-shards the input path.
+- ``host-scalar-loop`` — ``float()``/``int()`` scalarization of a
+  jitted callable's result inside a ``*_loop`` function: a
+  per-iteration blocking D2H of one scalar, the classic hidden
+  dispatch stall.
+
+Message code prefixes (``implicit-transfer:``, ``unsharded-device-put:``,
+``host-scalar-loop:``) are documented in docs/ANALYSIS.md; the
+suppression key is the family name ``transfer-flow``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from r2d2_tpu.analysis.core import Context, Finding, dotted_name, rule
+from r2d2_tpu.analysis.donation import (
+    _DonateSite,
+    _bound_name,
+    _callee_name,
+    collect_donating_sites,
+)
+from r2d2_tpu.analysis.jit_purity import _FuncNode
+
+RULE = "transfer-flow"
+
+_NP_CASTS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+_MESH_SCOPES = ("r2d2_tpu/parallel/", "r2d2_tpu/learner/")
+_SHARDING_KWARGS = {"device", "sharding", "donate"}
+
+
+def _jit_bound_names(tree: ast.AST) -> Dict[str, _DonateSite]:
+    """Every local/attr name bound to a jit/pjit result (donating or
+    not) — donation.py's collector already resolves the assignment,
+    decorator, factory-return and wrap idioms."""
+    return collect_donating_sites(tree)
+
+
+def _is_device_get(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) == "jax.device_get")
+
+
+def _check_implicit_transfer(rel: str, fn: ast.AST,
+                             jit_names: Dict[str, _DonateSite],
+                             out: List[Finding],
+                             seen: Set[Tuple[int, str]]) -> None:
+    # names assigned (possibly tuple-unpacked) from a jitted call
+    results: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            callee = _callee_name(node.value)
+            if callee in jit_names:
+                for t in node.targets:
+                    for el in (t.elts if isinstance(t, (ast.Tuple,
+                                                        ast.List))
+                               else [t]):
+                        name = _bound_name(el)
+                        if name:
+                            results.add(name)
+
+    def emit(line: int, msg: str) -> None:
+        key = (line, msg)
+        if key not in seen:
+            seen.add(key)
+            out.append(Finding(RULE, rel, line, msg))
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func) or ""
+        if d not in _NP_CASTS or not node.args:
+            continue
+        arg = node.args[0]
+        if _is_device_get(arg):
+            continue  # np.asarray(jax.device_get(x)): explicit fetch
+        target: Optional[str] = None
+        if isinstance(arg, ast.Call) and _callee_name(arg) in jit_names:
+            target = f"{_callee_name(arg)}(...)"
+        elif isinstance(arg, ast.Name) and arg.id in results:
+            target = arg.id
+        elif (isinstance(arg, ast.Attribute)
+              and isinstance(arg.value, ast.Name)
+              and arg.attr in results):
+            target = arg.attr
+        if target is not None:
+            emit(node.lineno,
+                 f"implicit-transfer: {d}({target}) materializes a "
+                 f"jitted callable's device result via an implicit "
+                 f"D2H — use jax.device_get inside a "
+                 f"HOST_TRANSFERS.allowed(...) span")
+
+
+def _check_device_put(rel: str, tree: ast.AST, out: List[Finding]
+                      ) -> None:
+    if not rel.startswith(_MESH_SCOPES):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func) != "jax.device_put":
+            continue
+        has_placement = (len(node.args) >= 2
+                         or any(kw.arg in _SHARDING_KWARGS
+                                for kw in node.keywords))
+        if not has_placement:
+            out.append(Finding(
+                RULE, rel, node.lineno,
+                "unsharded-device-put: jax.device_put without an "
+                "explicit sharding/device in a mesh-aware module — the "
+                "buffer lands on the default device and un-shards the "
+                "input path"))
+
+
+def _check_host_scalar_loop(rel: str, fn: ast.AST,
+                            jit_names: Dict[str, _DonateSite],
+                            out: List[Finding],
+                            seen: Set[Tuple[int, str]]) -> None:
+    if not getattr(fn, "name", "").endswith("_loop"):
+        return
+    results: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            callee = _callee_name(node.value)
+            if callee in jit_names:
+                for t in node.targets:
+                    for el in (t.elts if isinstance(t, (ast.Tuple,
+                                                        ast.List))
+                               else [t]):
+                        if isinstance(el, ast.Name):
+                            results.add(el.id)
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int")
+                and len(node.args) == 1):
+            continue
+        arg = node.args[0]
+        hit: Optional[str] = None
+        if isinstance(arg, ast.Name) and arg.id in results:
+            hit = arg.id
+        elif (isinstance(arg, ast.Call)
+              and _callee_name(arg) in jit_names):
+            hit = f"{_callee_name(arg)}(...)"
+        if hit is not None:
+            key = (node.lineno, hit)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                RULE, rel, node.lineno,
+                f"host-scalar-loop: {node.func.id}({hit}) inside loop "
+                f"function {fn.name!r} blocks on a device scalar every "
+                f"iteration — fetch once behind the declared harvest "
+                f"site"))
+
+
+@rule(RULE, "implicit device<->host transfers outside jit: numpy casts "
+            "of jitted results, unsharded device_put in mesh modules, "
+            "per-iteration scalarization in *_loop functions")
+def check_transfer_flow(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        jit_names = _jit_bound_names(mod.tree)
+        _check_device_put(mod.rel, mod.tree, findings)
+        seen: Set[Tuple[int, str]] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, _FuncNode):
+                _check_implicit_transfer(mod.rel, node, jit_names,
+                                         findings, seen)
+                _check_host_scalar_loop(mod.rel, node, jit_names,
+                                        findings, seen)
+    return findings

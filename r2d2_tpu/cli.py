@@ -258,6 +258,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(jax.distributed via JAX_COORDINATOR_ADDRESS / "
                          "JAX_NUM_PROCESSES / JAX_PROCESS_ID, or TPU-pod "
                          "autodetection); implies --mesh")
+    pt.add_argument("--transfer-guard", action="store_true", default=None,
+                    help="arm jax.transfer_guard('disallow') windows "
+                         "around every declared dispatch/harvest site "
+                         "after bring-up: an undeclared implicit "
+                         "device<->host transfer in the hot loop raises "
+                         "TransferGuardTripped (trip.* counters on "
+                         "/statusz) instead of silently stalling the "
+                         "stream; overrides cfg.transfer_guard "
+                         "(docs/ANALYSIS.md)")
     pt.add_argument("--sync", action="store_true",
                     help="deterministic single-thread trainer (debug)")
     pt.add_argument("--max-wall-seconds", type=float, default=None)
@@ -412,6 +421,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cfg = cfg.replace(population_spec=args.population)
             if args.league_eval:
                 cfg = cfg.replace(league_eval=True)
+            if args.transfer_guard:
+                cfg = cfg.replace(transfer_guard=True)
         except ValueError as e:
             parser.error(str(e))
         if args.sync and args.max_wall_seconds is not None:

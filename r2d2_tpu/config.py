@@ -344,6 +344,16 @@ class Config:
                                       # kernel keeps its 1.07x inference
                                       # edge, ops/lstm.py)
     pallas_interpret: bool = False    # run pallas kernels interpreted (CPU tests)
+    transfer_guard: bool = False      # arm jax.transfer_guard("disallow")
+                                      # windows around every declared
+                                      # dispatch/harvest site: an
+                                      # UNDECLARED implicit device<->host
+                                      # transfer in the hot loop raises
+                                      # TransferGuardTripped instead of
+                                      # silently stalling the stream
+                                      # (docs/ANALYSIS.md; armed after
+                                      # bring-up so compile-time staging
+                                      # is never misattributed)
     mesh_shape: Tuple[Tuple[str, int], ...] = ()  # learner mesh axes, e.g.
                                       # (("dp", 4), ("fsdp", 2), ("tp", 2)):
                                       # dp = data parallel (batch rows,

@@ -74,7 +74,7 @@ from r2d2_tpu.models.network import R2D2Network
 from r2d2_tpu.replay.device_ring import gather_batch
 from r2d2_tpu.utils.math import epsilon_ladder
 from r2d2_tpu.utils.resilience import Deadline
-from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
+from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES, TRANSFER_GUARD
 
 log = logging.getLogger(__name__)
 
@@ -751,7 +751,7 @@ def make_debug_rollout(cfg: Config, net: R2D2Network, env: Any,
         return jax.lax.scan(env_it, (ast, arrays, prios, seq_meta, first),
                             None, length=steps)
 
-    return jax.jit(rollout)
+    return jax.jit(rollout)  # graftlint: disable=donation-discipline -- test-only parity harness: the host oracle replays the same inputs after the call, so nothing may be donated
 
 
 # --------------------------------------------------------------------------
@@ -887,40 +887,48 @@ class AnakinPlane:
     def rollout_step(self, params) -> None:
         """One warm-up dispatch (env/actor/ring-write only), harvested
         synchronously — the fill counter gates the switch to training."""
-        ast, arrays, prios, seq_meta, first, stats = self.rollout(
-            params, self.state, *self._handles())
-        self.state = ast
-        self._store(arrays, prios, seq_meta, first)
-        with self._stats_lock:
-            self.frames += self._frames_per_dispatch
-        HOST_TRANSFERS.count("anakin.result_fetch")
-        self._absorb(np.asarray(jax.device_get(stats)))
+        with TRANSFER_GUARD.disallow("anakin.rollout"):
+            ast, arrays, prios, seq_meta, first, stats = self.rollout(
+                params, self.state, *self._handles())
+            self.state = ast
+            self._store(arrays, prios, seq_meta, first)
+            with self._stats_lock:
+                self.frames += self._frames_per_dispatch
+            with HOST_TRANSFERS.allowed("anakin.result_fetch"):
+                stats_np = np.asarray(jax.device_get(stats))
+        self._absorb(stats_np)
 
     def dispatch(self, train_state: TrainState):
         """One fused super-step dispatch.  Returns ``(train_state', flat)``
         with the result vector's D2H copy already started — harvest later
         (pipelined) via :meth:`harvest`."""
-        idx = jnp.asarray(self.dispatch_no & 0xFFFFFFFF, jnp.uint32)
-        self.dispatch_no += 1
-        train_state, ast, arrays, prios, seq_meta, first, flat = (
-            self.super_step(train_state, self.state, *self._handles(), idx))
-        self.state = ast
-        self._store(arrays, prios, seq_meta, first)
-        with self._stats_lock:
-            self.frames += self._frames_per_dispatch
-            self.super_steps += 1
-        try:
-            flat.copy_to_host_async()
-        except Exception:
-            pass  # no async copies on this backend: harvest pays the trip
+        with TRANSFER_GUARD.disallow("anakin.dispatch"):
+            # the loop's ONE recurring H2D: the dispatch index scalar
+            with HOST_TRANSFERS.allowed("anakin.dispatch_put"):
+                idx = jnp.asarray(self.dispatch_no & 0xFFFFFFFF,
+                                  jnp.uint32)
+            self.dispatch_no += 1
+            train_state, ast, arrays, prios, seq_meta, first, flat = (
+                self.super_step(train_state, self.state,
+                                *self._handles(), idx))
+            self.state = ast
+            self._store(arrays, prios, seq_meta, first)
+            with self._stats_lock:
+                self.frames += self._frames_per_dispatch
+                self.super_steps += 1
+            try:
+                flat.copy_to_host_async()  # explicit: guard-exempt
+            except Exception:
+                pass  # no async copies on this backend: harvest pays it
         return train_state, flat
 
     def harvest(self, flat) -> np.ndarray:
         """Fetch one dispatch's result vector — the loop's ONLY recurring
         device→host crossing — and fold its deltas into the host
         counters.  Returns the k inner-step losses."""
-        HOST_TRANSFERS.count("anakin.result_fetch")
-        v = np.asarray(jax.device_get(flat))
+        with TRANSFER_GUARD.disallow("anakin.harvest"):
+            with HOST_TRANSFERS.allowed("anakin.result_fetch"):
+                v = np.asarray(jax.device_get(flat))
         k = self.cfg.superstep_k
         losses = v[:k]
         stats = v[k:k + len(STATS_FIELDS)]
@@ -999,11 +1007,11 @@ class AnakinPlane:
         (env phase/t/keys, agent obs/LSTM carry, local buffers), ring
         arrays, and the PER leaf/metadata state.  Call only with no
         dispatch in flight (the driver drains its pipeline first)."""
-        HOST_TRANSFERS.count("anakin.snapshot_fetch")
         arrays, prios, seq_meta, first = self._handles()
-        host = jax.device_get(dict(state=self.state, ring=arrays,
-                                   prios=prios, seq_meta=seq_meta,
-                                   first=first))
+        with HOST_TRANSFERS.allowed("anakin.snapshot_fetch"):
+            host = jax.device_get(dict(state=self.state, ring=arrays,
+                                       prios=prios, seq_meta=seq_meta,
+                                       first=first))
         flat: Dict[str, np.ndarray] = {}
         for k, v in host["state"].items():
             flat[f"state_{k}"] = np.asarray(v)
@@ -1215,39 +1223,57 @@ def run_anakin_loop(learner: Any, plane: AnakinPlane,
                 budget.elapsed(), cfg.dispatch_deadline)
             wedged = True
 
-    while updates < target and not wedged:
-        if stop is not None and stop():
-            break
-        if not plane.ready:
-            with tracer.span("anakin.rollout_dispatch"):
-                plane.rollout_step(learner.state.params)
-            continue
-        with tracer.span("learner.step_dispatch"):
-            learner.state, flat = plane.dispatch(learner.state)
-        pending.append(flat)
-        while len(pending) > cfg.superstep_pipeline and not wedged:
-            with tracer.span("learner.result_sync"):
-                harvest_one()
+    # cfg.transfer_guard: arm the process guard once warm-up ends, so
+    # every disallow window in dispatch/harvest/rollout actually runs
+    # jax.transfer_guard("disallow") — an undeclared implicit crossing
+    # raises TransferGuardTripped instead of silently stalling the
+    # stream.  Armed AFTER the rollout warm-up: compile-time constant
+    # staging belongs to bring-up, not the steady-state budget.
+    from contextlib import ExitStack
 
-        prev, updates = updates, updates + k
-        if (learner.param_store is not None
-                and updates // cfg.weight_publish_interval
-                > prev // cfg.weight_publish_interval):
-            learner._publish()
-        if (learner.checkpointer is not None
-                and updates // cfg.save_interval
-                > prev // cfg.save_interval):
-            learner.env_steps = plane.env_steps
-            learner._save(updates, t0)
-        if (snapshot_fn is not None and cfg.replay_snapshot_interval > 0
-                and time.time() - last_snap > cfg.replay_snapshot_interval):
-            while pending and not hard_wedged:
-                harvest_one()   # snapshots need no dispatch in flight
-            if not hard_wedged:
-                snapshot_fn(updates)
-                last_snap = time.time()
-    while pending and not hard_wedged:
-        harvest_one()
+    guard_stack = ExitStack()
+    guard_armed = False
+    try:
+        while updates < target and not wedged:
+            if stop is not None and stop():
+                break
+            if not plane.ready:
+                with tracer.span("anakin.rollout_dispatch"):
+                    plane.rollout_step(learner.state.params)
+                continue
+            if cfg.transfer_guard and not guard_armed:
+                guard_stack.enter_context(TRANSFER_GUARD.arm())
+                guard_armed = True
+            with tracer.span("learner.step_dispatch"):
+                learner.state, flat = plane.dispatch(learner.state)
+            pending.append(flat)
+            while len(pending) > cfg.superstep_pipeline and not wedged:
+                with tracer.span("learner.result_sync"):
+                    harvest_one()
+
+            prev, updates = updates, updates + k
+            if (learner.param_store is not None
+                    and updates // cfg.weight_publish_interval
+                    > prev // cfg.weight_publish_interval):
+                learner._publish()
+            if (learner.checkpointer is not None
+                    and updates // cfg.save_interval
+                    > prev // cfg.save_interval):
+                learner.env_steps = plane.env_steps
+                learner._save(updates, t0)
+            if (snapshot_fn is not None
+                    and cfg.replay_snapshot_interval > 0
+                    and time.time() - last_snap
+                    > cfg.replay_snapshot_interval):
+                while pending and not hard_wedged:
+                    harvest_one()   # snapshots need no dispatch in flight
+                if not hard_wedged:
+                    snapshot_fn(updates)
+                    last_snap = time.time()
+        while pending and not hard_wedged:
+            harvest_one()
+    finally:
+        guard_stack.close()
     if wedged and snapshot_fn is not None:
         # the resumable artifact of the clean abort: full loop state,
         # parked where --resume restores it bit-exact.  On a HARD wedge

@@ -41,6 +41,7 @@ from r2d2_tpu.parallel.sharding import (
     pjit_train_step,
 )
 from r2d2_tpu.utils.store import ParamStore
+from r2d2_tpu.utils.trace import HOST_TRANSFERS, TRANSFER_GUARD
 
 def _aval_tree(tree):
     """ShapeDtypeStruct avals (shape/dtype/sharding) for every leaf —
@@ -184,8 +185,11 @@ class Learner:
                 self.mesh, {k: batch[k] for k in DEVICE_BATCH_KEYS},
                 shardings=self._shardings)
         else:
-            dev = {k: jax.device_put(batch[k], self._shardings[k])
-                   for k in DEVICE_BATCH_KEYS}
+            with TRANSFER_GUARD.disallow("learner.stage"):
+                # explicit device_put with a sharding: guard-exempt —
+                # the window catches any *implicit* H2D sneaking in
+                dev = {k: jax.device_put(batch[k], self._shardings[k])
+                       for k in DEVICE_BATCH_KEYS}
         return dev, host
 
     def run(self, batch_source: BatchSource,
@@ -299,7 +303,9 @@ class Learner:
             pipeline the fetch usually finds host-resident bytes instead
             of paying a fresh interconnect round trip."""
             host, loss, priorities = pending_item
-            with tracer.span("learner.result_sync"):
+            with tracer.span("learner.result_sync"), \
+                    TRANSFER_GUARD.disallow("learner.harvest"), \
+                    HOST_TRANSFERS.allowed("learner.result_fetch"):
                 if self._lh:
                     # the learnhealth diag rides the same flat fetch
                     flat = np.asarray(jax.device_get(loss))
@@ -347,7 +353,8 @@ class Learner:
                 if any_host(item is None):
                     break
                 dev_batch, host = item
-                with tracer.span("learner.step_dispatch"):
+                with tracer.span("learner.step_dispatch"), \
+                        TRANSFER_GUARD.disallow("learner.dispatch"):
                     if self._lh:
                         (self.state, loss, priorities,
                          diag) = self._step_fn(self.state, dev_batch)
@@ -359,11 +366,11 @@ class Learner:
                     else:
                         self.state, loss, priorities = self._step_fn(
                             self.state, dev_batch)
-                for arr in (loss, priorities):
-                    try:
-                        arr.copy_to_host_async()
-                    except Exception:
-                        pass  # any prefetch failure: harvest pays the trip
+                    for arr in (loss, priorities):
+                        try:
+                            arr.copy_to_host_async()  # explicit: exempt
+                        except Exception:
+                            pass  # prefetch failure: harvest pays the trip
                 pending.append((host, loss, priorities))
                 while len(pending) > cfg.superstep_pipeline:
                     harvest(pending.popleft())
@@ -503,18 +510,24 @@ class Learner:
         def harvest(item) -> None:
             """Fetch a finished super-step's results and feed them back."""
             meta, flat = item
-            with tracer.span("learner.result_sync"):
+            with tracer.span("learner.result_sync"), \
+                    TRANSFER_GUARD.disallow("learner.harvest"):
                 # one D2H fetch for everything the host needs (usually
                 # already prefetched by prepare())
-                flat = np.asarray(jax.device_get(flat))
+                with HOST_TRANSFERS.allowed("learner.result_fetch"):
+                    flat = np.asarray(jax.device_get(flat))
             diags = (flat[k + k * B:].reshape(k, -1) if self._lh else None)
             self._feed_back(meta, flat[:k], flat[k:k + k * B].reshape(k, B),
                             priority_sink, losses_hist, diags)
 
         def dispatch(ints, weights):
-            with tracer.span("learner.step_dispatch"):
-                out = compiled(self.state, ring.snapshot(),
-                               jnp.asarray(ints), jnp.asarray(weights))
+            with tracer.span("learner.step_dispatch"), \
+                    TRANSFER_GUARD.disallow("learner.dispatch"):
+                # the dispatch's declared H2D: the sampled idx/weight rows
+                with HOST_TRANSFERS.allowed("learner.dispatch_put"):
+                    d_ints = jnp.asarray(ints)
+                    d_w = jnp.asarray(weights)
+                out = compiled(self.state, ring.snapshot(), d_ints, d_w)
                 if self._lh:
                     st, losses, priorities, diags = out
                     return st, (losses, diags), priorities
@@ -688,15 +701,18 @@ class Learner:
         dispatch_no = [0]
 
         def sample():
-            with tracer.span("learner.step_dispatch"):
+            with tracer.span("learner.step_dispatch"), \
+                    TRANSFER_GUARD.disallow("learner.dispatch"):
                 with buffer.lock:
                     # fold_in(PRNGKey(cfg.seed), idx) happens in-graph;
                     # the u32 counter wraps harmlessly after 2^32.
                     # Multi-host: every process dispatches in lockstep
                     # (collective gate), so the counters — and with them
                     # the in-graph sampling streams — stay identical.
-                    idx = jnp.asarray(
-                        dispatch_no[0] & 0xFFFFFFFF, jnp.uint32)
+                    # ONE declared H2D per dispatch: the index scalar
+                    with HOST_TRANSFERS.allowed("learner.dispatch_put"):
+                        idx = jnp.asarray(
+                            dispatch_no[0] & 0xFFFFFFFF, jnp.uint32)
                     dispatch_no[0] += 1
                     out = compiled(self.state, *ring_args(), idx)
                     if self._lh:
@@ -724,8 +740,10 @@ class Learner:
 
         def harvest(item) -> None:
             meta, losses = item
-            with tracer.span("learner.result_sync"):
-                flat = np.asarray(jax.device_get(losses))
+            with tracer.span("learner.result_sync"), \
+                    TRANSFER_GUARD.disallow("learner.harvest"):
+                with HOST_TRANSFERS.allowed("learner.result_fetch"):
+                    flat = np.asarray(jax.device_get(losses))
             losses_np = flat[:k]
             diags = flat[k:].reshape(k, -1) if self._lh else None
             self._note_results(losses_np, diags)

@@ -29,7 +29,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from r2d2_tpu.config import Config
-from r2d2_tpu.utils.trace import HOST_TRANSFERS
+from r2d2_tpu.utils.trace import HOST_TRANSFERS, TRANSFER_GUARD
 
 
 def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
@@ -162,6 +162,8 @@ class ContinuousBatcher:
         regardless of size, the serve plane's own invariant."""
         if self._params is None:
             raise RuntimeError("no params published yet")
+        import jax
+
         n = len(obs)
         b = self.bucket(n)
         s = self._pad(b)
@@ -174,11 +176,18 @@ class ContinuousBatcher:
             s["last_action"][n:] = 0.0
             s["last_reward"][n:] = 0.0
             s["hidden"][n:] = 0.0
-        q, new_hidden = self._act(self._params, s["obs"], s["last_action"],
-                                  s["last_reward"], s["hidden"])
-        q = np.asarray(q)
-        new_hidden = np.asarray(new_hidden)
-        HOST_TRANSFERS.count("serving.act_fetch")
+        with TRANSFER_GUARD.disallow("serving.act"):
+            # the batch's declared H2D: the padded scratch rows ride the
+            # dispatch as implicit transfers of numpy args
+            with HOST_TRANSFERS.allowed("serving.act_put"):
+                q, new_hidden = self._act(self._params, s["obs"],
+                                          s["last_action"],
+                                          s["last_reward"], s["hidden"])
+            # ONE explicit D2H for both outputs (audit r19: was two
+            # implicit np.asarray syncs — same values, one blocking
+            # fetch, and explicit transfers stay guard-exempt)
+            with HOST_TRANSFERS.allowed("serving.act_fetch"):
+                q, new_hidden = jax.device_get((q, new_hidden))
         return q[:n], new_hidden[:n]
 
     def warmup(self) -> None:

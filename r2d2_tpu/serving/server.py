@@ -305,9 +305,14 @@ class SessionServer:
                 self.registry.inc("serving.gone")
                 self._reply(st, sid, seq, STATUS_GONE)
                 return
+            # zero-copy views: the frame body is per-frame immutable
+            # bytes (FrameReader.poll), so the request can alias it for
+            # its queued lifetime — the batch path copies exactly once,
+            # into the batcher's padded scratch (audit r19: np.array
+            # here double-materialized every obs on the ingest path)
             req = Request(st.cid, sid, seq, bool(aux & FLAG_RESET),
-                          np.array(views["obs"]),
-                          np.array(views["last_action"]),
+                          np.asarray(views["obs"]),
+                          np.asarray(views["last_action"]),
                           float(views["last_reward"][0]))
             if not self.admission.submit(req):
                 self.store.clear_pending(sid)

@@ -366,9 +366,14 @@ class Telemetry:
             elif rh.get("priorities"):
                 _prio_row(rh["priorities"])
         # the runtime guard surfaces (utils/trace.py process-wide views)
-        from r2d2_tpu.utils.trace import HOST_TRANSFERS, RETRACES
+        from r2d2_tpu.utils.trace import (
+            HOST_TRANSFERS,
+            RETRACES,
+            TRANSFER_GUARD,
+        )
 
         reg.absorb_counters("host_transfers", HOST_TRANSFERS.snapshot())
+        reg.absorb_counters("transfer_guard", TRANSFER_GUARD.snapshot())
         for name, traces in RETRACES.counts().items():
             reg.set_gauge("retraces.max_traces", traces, entry_point=name)
 

@@ -29,6 +29,14 @@ the survey calls for:
   device↔host crossings of the ingest and inference-service hot loops,
   so "the serve loop fetches once per batch, not once per lane" is an
   assertable invariant rather than a hope.
+- :class:`TransferGuard` — :data:`TRANSFER_GUARD` upgrades the counted
+  contract to an *enforced* one: when armed, each dispatch/fetch hot
+  window runs under a scoped ``jax.transfer_guard("disallow")`` so any
+  device↔host crossing that is not a declared site (an explicit
+  ``device_put``/``device_get``/``copy_to_host_async``, or an implicit
+  fetch inside a ``HOST_TRANSFERS.allowed(...)`` span) raises instead
+  of silently stalling the loop.  Disarmed (the default) every window
+  is a no-op, so production call sites are unconditional.
 
 Everything is thread-safe and allocation-light: spans cost two
 ``perf_counter`` calls and a lock-free float update per use, so they can
@@ -245,6 +253,17 @@ class TransferCounter:
         with self._lock:
             self._counts[name] = self._counts.get(name, 0) + n
 
+    @contextlib.contextmanager
+    def allowed(self, name: str, n: int = 1) -> Iterator[None]:
+        """A declared-transfer span: tick the counter AND open a
+        ``jax.transfer_guard("allow")`` window (via the process-wide
+        :data:`TRANSFER_GUARD`), so the one sanctioned fetch/put inside
+        a ``disallow`` window neither trips the guard nor escapes the
+        budget book-keeping.  Disarmed, this is exactly ``count()``."""
+        self.count(name, n)
+        with TRANSFER_GUARD.allow():
+            yield
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._counts)
@@ -258,11 +277,118 @@ class TransferCounter:
             self._counts.clear()
 
 
+class TransferGuardTripped(RuntimeError):
+    """An undeclared device↔host transfer inside a disallow window.
+
+    Raised by :meth:`TransferGuard.disallow` wrapping jax's own guard
+    error so call sites (and the OPERATIONS failure matrix) have one
+    stable exception type with the window name attached."""
+
+
+class TransferGuard:
+    """Scoped ``jax.transfer_guard`` enforcement for the hot loops.
+
+    The declared-transfer budget (one H2D per dispatch, one D2H per
+    harvest — Podracer, PAPERS.md) has always been *counted* by
+    :data:`HOST_TRANSFERS`; this makes JAX itself reject what the count
+    would only reveal after the fact.  Each dispatch/fetch window wraps
+    its body in ``disallow(where)``; the declared crossings inside run
+    under ``HOST_TRANSFERS.allowed(name)`` (or are explicit
+    ``device_put``/``device_get`` calls, which jax's ``disallow`` level
+    permits by design — only *implicit* transfers trip it).
+
+    Disarmed (the default) every window is a no-op with no jax import,
+    so the guard costs one attribute read on production paths.  Tests
+    and ``cfg.transfer_guard`` arm it; arming nests.  Arm AFTER the
+    first compile of an entry point: trace-time constant materialization
+    during compilation is outside the steady-state budget contract.
+
+    jax's transfer guards are thread-local by design; ``arm`` flips a
+    process-wide flag but each window only guards the thread that enters
+    it — which is exactly the dispatch/harvest thread the budget is
+    about.
+    """
+
+    def __init__(self):
+        self._armed = 0
+        self._windows: Dict[str, int] = {}
+        self._trips: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def armed(self) -> bool:
+        return self._armed > 0
+
+    @contextlib.contextmanager
+    def arm(self) -> Iterator[None]:
+        with self._lock:
+            self._armed += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._armed -= 1
+
+    @contextlib.contextmanager
+    def disallow(self, where: str) -> Iterator[None]:
+        """Enforcement window: armed, any *implicit* device↔host
+        transfer inside raises :class:`TransferGuardTripped` naming the
+        window.  Disarmed: free pass-through."""
+        if not self.armed:
+            yield
+            return
+        with self._lock:
+            self._windows[where] = self._windows.get(where, 0) + 1
+        import jax
+
+        try:
+            with jax.transfer_guard("disallow"):
+                yield
+        except Exception as e:  # jax raises a plain RuntimeError/ValueError
+            if "transfer" not in str(e).lower():
+                raise
+            with self._lock:
+                self._trips[where] = self._trips.get(where, 0) + 1
+            raise TransferGuardTripped(
+                f"undeclared device<->host transfer inside guard window "
+                f"{where!r}: {e}") from e
+
+    @contextlib.contextmanager
+    def allow(self) -> Iterator[None]:
+        """A sanctioned-transfer span inside a ``disallow`` window
+        (normally entered via :meth:`TransferCounter.allowed`, which
+        also books the crossing)."""
+        if not self.armed:
+            yield
+            return
+        import jax
+
+        with jax.transfer_guard("allow"):
+            yield
+
+    def snapshot(self) -> Dict[str, int]:
+        """``window.<name>`` = disallow windows entered while armed,
+        ``trip.<name>`` = undeclared transfers caught (should be 0 —
+        a non-zero trip counter is the OPERATIONS failure-matrix
+        signal)."""
+        with self._lock:
+            out = {f"window.{k}": v for k, v in self._windows.items()}
+            out.update({f"trip.{k}": v for k, v in self._trips.items()})
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._windows.clear()
+            self._trips.clear()
+
+
 # process-wide instances: jitted entry points register with RETRACES at
-# build time; the ingest / inference-service loops tick HOST_TRANSFERS.
+# build time; the ingest / inference-service loops tick HOST_TRANSFERS
+# and open TRANSFER_GUARD windows around their dispatch/fetch bodies.
 # Subprocess fleets get their own (fresh) instances after spawn.
 RETRACES = RetraceGuard()
 HOST_TRANSFERS = TransferCounter()
+TRANSFER_GUARD = TransferGuard()
 
 
 @contextlib.contextmanager

@@ -341,6 +341,65 @@ def test_anakin_host_transfers_constant_per_superstep():
         RETRACES.assert_within_budgets()
 
 
+def test_anakin_loop_arms_transfer_guard_from_config():
+    """cfg.transfer_guard=True (the ``--transfer-guard`` CLI knob) arms
+    the process guard for the TRAINING phase of run_anakin_loop —
+    windows book on the jax-enforced side (they only count while
+    armed), the run completes clean, and the guard is disarmed again on
+    exit so later code in the process is unaffected."""
+    from r2d2_tpu.utils.trace import TRANSFER_GUARD
+
+    cfg = anakin_config(transfer_guard=True, training_steps=8)
+    net, plane, learner = build_plane(cfg)
+    w0 = TRANSFER_GUARD.snapshot().get("window.anakin.dispatch", 0)
+    m = run_anakin_loop(learner, plane)
+    assert m["num_updates"] >= 8
+    assert not m["dispatch_wedged"]
+    assert not TRANSFER_GUARD.armed
+    assert TRANSFER_GUARD.snapshot().get("window.anakin.dispatch", 0) > w0
+
+
+def test_anakin_host_transfers_jax_enforced_when_armed():
+    """The armed variant (r19): the same one-fetch-per-dispatch budget,
+    but now JAX-enforced — dispatch and harvest run inside
+    ``transfer_guard("disallow")`` windows (the plane's own
+    TRANSFER_GUARD.disallow sites), so the declared crossings (the
+    dispatch-index H2D inside ``anakin.dispatch_put``, the result fetch
+    inside ``anakin.result_fetch``) are the ONLY ones that pass.  An
+    undeclared implicit transfer sneaking into the hot loop raises
+    TransferGuardTripped rather than surviving until a real
+    accelerator run.  Armed AFTER warm-up, the production order."""
+    from r2d2_tpu.utils.trace import (
+        HOST_TRANSFERS,
+        RETRACES,
+        TRANSFER_GUARD,
+    )
+
+    cfg = anakin_config(training_steps=10 ** 9, num_actors=2,
+                        superstep_k=2, anakin_env_steps_per_update=4)
+    net, plane, learner = build_plane(cfg)
+    while not plane.ready:
+        plane.rollout_step(learner.state.params)
+
+    fetch0 = HOST_TRANSFERS.get("anakin.result_fetch")
+    put0 = HOST_TRANSFERS.get("anakin.dispatch_put")
+    dispatches = 5
+    with TRANSFER_GUARD.arm():
+        for _ in range(dispatches):
+            learner.state, flat = plane.dispatch(learner.state)
+            plane.harvest(flat)
+    # budgets unchanged under enforcement: one D2H fetch and one H2D
+    # index put per dispatch, nothing else crossed
+    assert HOST_TRANSFERS.get("anakin.result_fetch") - fetch0 \
+        == dispatches
+    assert HOST_TRANSFERS.get("anakin.dispatch_put") - put0 == dispatches
+    snap = TRANSFER_GUARD.snapshot()
+    for w in ("anakin.dispatch", "anakin.harvest"):
+        assert snap.get(f"trip.{w}", 0) == 0, snap
+        assert snap.get(f"window.{w}", 0) >= dispatches, snap
+    RETRACES.assert_within_budgets()
+
+
 # --------------------------------------------------------------- training
 
 def test_anakin_train_fast_plumbing():

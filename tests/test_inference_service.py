@@ -157,8 +157,12 @@ def test_serve_request_crc_drops_garbled_slab():
         v["obs"][0, 0] ^= 0xFF   # garble AFTER the CRC landed
         ch.req_q.put((1, 1))
         hidden_before = svc.hidden.copy()
-        for _ in range(20):
-            svc.serve_once(idle_sleep=0.0)
+        # poll-with-deadline (the r07 deflake convention): a fixed
+        # iteration count races the mp.Queue feeder-thread flush of the
+        # request token (~ms on a loaded host)
+        deadline = time.time() + 30
+        while svc.requests_corrupt == 0 and time.time() < deadline:
+            svc.serve_once(idle_sleep=0.001)
         assert svc.requests_corrupt == 1
         assert svc.health()["requests_corrupt"] == 1
         assert svc.batches == 0                # dropped, not served

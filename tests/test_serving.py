@@ -274,6 +274,46 @@ def test_batcher_bucket_padding_bit_exact_and_retrace_budget():
     RETRACES.assert_within_budgets()
 
 
+def test_batcher_act_under_armed_transfer_guard():
+    """The serve path's declared-transfer contract, JAX-enforced (r19):
+    after warm-up, ``act()`` runs inside ``disallow("serving.act")`` —
+    the padded-scratch H2D rides the ``serving.act_put`` allow span and
+    the ONE result fetch is an explicit ``jax.device_get`` inside
+    ``serving.act_fetch``.  Results stay bit-exact vs the unarmed path,
+    one fetch per batch regardless of ragged size, zero trips."""
+    from r2d2_tpu.utils.trace import HOST_TRANSFERS, TRANSFER_GUARD
+
+    cfg = _cfg(serve_max_batch=8)
+    net, params = _net_params(cfg)
+    b = ContinuousBatcher(cfg, A)
+    b.publish(params)
+    b.warmup()  # every bucket compiled before arming
+
+    rng = np.random.default_rng(7)
+    batches = []
+    for n in (1, 3, 8):
+        batches.append((
+            rng.integers(0, 256,
+                         (n, *cfg.stored_obs_shape)).astype(np.uint8),
+            rng.random((n, A)).astype(np.float32),
+            rng.random(n).astype(np.float32),
+            (rng.normal(size=(n, 2, cfg.lstm_layers, cfg.hidden_dim))
+             * 0.1).astype(np.float32)))
+    unarmed = [b.act(*args) for args in batches]
+
+    fetch0 = HOST_TRANSFERS.get("serving.act_fetch")
+    with TRANSFER_GUARD.arm():
+        armed = [b.act(*args) for args in batches]
+    assert HOST_TRANSFERS.get("serving.act_fetch") - fetch0 \
+        == len(batches)
+    snap = TRANSFER_GUARD.snapshot()
+    assert snap.get("trip.serving.act", 0) == 0, snap
+    assert snap.get("window.serving.act", 0) >= len(batches)
+    for (q1, h1), (q2, h2) in zip(unarmed, armed):
+        np.testing.assert_array_equal(q1, q2)
+        np.testing.assert_array_equal(h1, h2)
+
+
 def test_serve_dtype_bf16_quantizes_with_greedy_parity():
     """QuaRL gate (the param_pump_dtype pattern on the serving tier):
     bf16 publish must actually quantize (params differ) while greedy

@@ -294,6 +294,47 @@ def test_pjit_step_retrace_and_transfer_discipline():
     guard.assert_within_budgets()
 
 
+def test_pjit_step_transfer_guard_armed_dp2():
+    """The dp=2 step under an ARMED jax transfer guard (r19): after the
+    warm-up trace, dispatch runs entirely on pre-sharded device args and
+    harvest is one explicit ``jax.device_get`` — both inside
+    ``transfer_guard("disallow")`` windows, so any *implicit* crossing
+    (a host numpy leaking into the dispatch, a stray ``np.asarray`` on
+    the loss) raises TransferGuardTripped instead of silently staging a
+    transfer.  ``shard_batch``'s ``device_put`` is explicit and
+    therefore guard-exempt by jax's own semantics."""
+    from r2d2_tpu.utils.trace import TRANSFER_GUARD
+
+    cfg = make_test_config(batch_size=8, mesh_shape=(("dp", 2),))
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    table = ShardingTable(make_mesh(cfg), cfg)
+    state = create_train_state(cfg, params)
+    step = pjit_train_step(cfg, net, table, state_template=state)
+    st = table.place_state(state)
+
+    # warm-up: the one trace happens outside the armed region (compile
+    # does its own constant staging; arming after warm-up is the
+    # production arming order too — train.py arms post-bring-up)
+    hb = synthetic_batch(cfg, A, np.random.default_rng(0))
+    st, loss, _ = step(st, shard_batch(table, hb))
+    losses = [float(jax.device_get(loss))]
+
+    with TRANSFER_GUARD.arm():
+        for i in range(1, 5):
+            hb = synthetic_batch(cfg, A, np.random.default_rng(i))
+            with TRANSFER_GUARD.disallow("test.pjit_dispatch"):
+                db = shard_batch(table, hb)  # explicit put: exempt
+                st, loss, _ = step(st, db)
+            with TRANSFER_GUARD.disallow("test.pjit_harvest"):
+                losses.append(float(jax.device_get(loss)))
+    assert all(np.isfinite(l) for l in losses)
+    snap = TRANSFER_GUARD.snapshot()
+    assert snap.get("trip.test.pjit_dispatch", 0) == 0
+    assert snap.get("trip.test.pjit_harvest", 0) == 0
+    assert snap["window.test.pjit_dispatch"] == 4
+
+
 # ------------------------------------------------- checkpoint roundtrip
 
 def test_checkpoint_resharding_roundtrip(tmp_path):

@@ -34,50 +34,32 @@ def _src(s: str) -> str:
 # ------------------------------------------------------------ enforcement
 
 def test_repo_tree_is_clean():
-    """THE gate: ≥4 rule families active, zero unsuppressed findings over
-    the live tree.  Suppressions must carry no surprises either — the
-    allowed set is pinned so a new one is a conscious review decision."""
+    """THE gate, data-driven since r19: zero unsuppressed findings over
+    the live tree AND exact agreement with the committed baseline
+    (GRAFTLINT_BASELINE.json).  A new suppression, a dropped one, or a
+    count drift each fail here until the baseline is consciously
+    regenerated (``--write-baseline``) in the same review."""
+    from r2d2_tpu.analysis import baseline as bl
+
     report = run_analysis([os.path.join(REPO_ROOT, "r2d2_tpu"),
                            os.path.join(REPO_ROOT, "tools")],
                           root=REPO_ROOT)
-    assert len(report.rules) >= 6
+    assert len(report.rules) >= 8
     assert {"jit-purity", "config-integrity", "thread-discipline",
-            "wire-format", "telemetry-discipline",
-            "bounded-wait"} <= set(report.rules)
+            "wire-format", "telemetry-discipline", "bounded-wait",
+            "donation-discipline", "transfer-flow"} <= set(report.rules)
     assert report.errors == []
     assert report.findings == [], "\n".join(
         f.format() for f in report.findings)
-    # every live suppression is a known, justified one
-    suppressed_at = {(f.path, f.rule) for f in report.suppressed}
-    assert suppressed_at <= {
-        ("r2d2_tpu/bench.py", "thread-discipline"),
-        # bounded-join fetch/snapshot helpers of the dispatch deadline:
-        # abandoned on a hard wedge by design, nothing to supervise
-        ("r2d2_tpu/learner/anakin.py", "thread-discipline"),
-        ("r2d2_tpu/parallel/actor_procs.py", "thread-discipline"),
-        # bounded_event_set: an abandon-on-timeout thread IS the point —
-        # a SIGKILL-corrupted mp.Event lock must never wedge a teardown
-        ("r2d2_tpu/utils/resilience.py", "thread-discipline"),
-        # nullable-tracer pass-through helper; call sites pass literals
-        ("r2d2_tpu/parallel/inference_service.py", "telemetry-discipline"),
-        # lineage flow-point pass-through helper; call sites pass literals
-        ("r2d2_tpu/replay/replay_buffer.py", "telemetry-discipline"),
-        # the Tracer.span -> event-tracer bridge forwards the span's
-        # literal name into an armed capture window
-        ("r2d2_tpu/utils/trace.py", "telemetry-discipline"),
-        # bulk absorption of fixed upstream surfaces (registry.absorb_*)
-        ("r2d2_tpu/telemetry/registry.py", "telemetry-discipline"),
-        # bounded measured bench producer thread (stop-event + joined),
-        # same justification as bench.py's measured threads
-        ("tools/replay_bench.py", "thread-discipline"),
-        # per-link net-replay receiver: owned by the link lifecycle
-        # (stopped by flag + joined in close()); a Supervisor restart
-        # loop would fight the link's own reconnect state machine
-        ("r2d2_tpu/parallel/replay_net.py", "thread-discipline"),
-        # fixed 3-entry literal-name table publishing client-side latency
-        # percentiles into the shared registry (not a hot-loop key)
-        ("tools/session_load_gen.py", "telemetry-discipline"),
-    }, suppressed_at
+    pinned = bl.load(os.path.join(REPO_ROOT, "GRAFTLINT_BASELINE.json"))
+    drift = bl.diff(pinned, report)
+    assert drift == [], "\n".join(drift)
+    # the committed baseline itself must pin a CLEAN tree — a baseline
+    # with live findings would let regressions ride in under the diff
+    assert pinned["findings"] == []
+    # every suppression in the baseline carries a written reason
+    for s in pinned["suppressions"]:
+        assert s["reasons"], f"reasonless suppression pinned: {s}"
 
 
 def test_cli_exits_zero_on_clean_tree_and_one_on_violation(tmp_path):
@@ -778,6 +760,401 @@ def test_wire_format_crc_helper_matches_legacy_convention():
     assert payload_crc32((7, 1), [a, b]) == (expect & CRC_MASK)
 
 
+# ------------------------------------------------ donation-discipline
+
+def test_donation_use_after_donate_direct_assignment():
+    """Reading a donated buffer after the call is the finding; rebinding
+    the name from the call result is the sanctioned shape."""
+    report = analyze_source(_src("""
+        import jax
+
+        def f(state, x):
+            return state
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def run(state, x):
+            out = step(state, x)
+            return state.mean()        # use-after-donate
+
+        def run_ok(state, x):
+            state = step(state, x)     # rebinding: clean
+            return state.mean()
+    """), rules=["donation-discipline"])
+    assert len(report.findings) == 1
+    assert report.findings[0].message.startswith("use-after-donate:")
+    assert "'state'" in report.findings[0].message
+
+
+def test_donation_use_after_donate_factory_and_wrap_idioms():
+    """The repo's factory-return + RETRACES.wrap idiom: donation info
+    rides from `return jax.jit(wrapped, donate_argnums=...)` through
+    `step = make_step(...)` to the call site — and the factory CALL
+    itself (whose args are cfg/net, not donated buffers) is never
+    flagged."""
+    report = analyze_source(_src("""
+        import jax
+        from r2d2_tpu.utils.trace import RETRACES
+
+        def make_step(cfg, net):
+            def step(state, batch):
+                return state
+            wrapped = RETRACES.wrap("fx.step", step)
+            return jax.jit(wrapped, donate_argnums=(0,))
+
+        def run(cfg, net, state, batch):
+            step = make_step(cfg, net)   # factory call: NOT a donation
+            out = step(state, batch)
+            return state.sum()           # use-after-donate via factory
+    """), rules=["donation-discipline"])
+    assert len(report.findings) == 1
+    assert report.findings[0].message.startswith("use-after-donate:")
+    assert "'state'" in report.findings[0].message
+
+
+def test_donation_multiline_call_span_is_not_use_after():
+    """Regression (live anakin dispatch shape): a donating call spanning
+    lines puts argument loads BELOW the call's first line and the tuple
+    target's Store ABOVE the value — neither may count as a read after
+    the donation."""
+    report = analyze_source(_src("""
+        import jax
+
+        def f(state, a, b, idx):
+            return state, idx
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def run(state, a, b, idx):
+            state, out = (
+                step(state, a,
+                     b, idx))
+            return state, out
+    """), rules=["donation-discipline"])
+    assert report.findings == []
+
+
+def test_donation_loop_carried_without_rebind():
+    """A donating call in a loop whose donated arg is never rebound
+    passes an already-donated buffer on iteration 2."""
+    report = analyze_source(_src("""
+        import jax
+
+        def f(state, x):
+            return x
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def run(state, xs):
+            for x in xs:
+                out = step(state, x)   # state never rebound: flagged
+            return out
+
+        def run_ok(state, xs):
+            for x in xs:
+                state = step(state, x)
+            return state
+    """), rules=["donation-discipline"])
+    assert len(report.findings) == 1
+    assert "inside a loop without being rebound" in \
+        report.findings[0].message.replace("\n", " ")
+
+
+def test_donation_argnames_kwarg_form():
+    report = analyze_source(_src("""
+        import jax
+
+        def f(x, state=None):
+            return x
+
+        step = jax.jit(f, donate_argnames=("state",))
+
+        def run(x, state):
+            out = step(x, state=state)
+            return state + 1           # use-after-donate via argnames
+    """), rules=["donation-discipline"])
+    assert len(report.findings) == 1
+    assert report.findings[0].message.startswith("use-after-donate:")
+
+
+def test_missed_donation_scoped_to_drivetrain_modules():
+    """The same jit site is a finding under learner/ and out of scope
+    under a neutral path; donating sites and suppressed sites pass."""
+    src = _src("""
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        step = jax.jit(train_step)
+    """)
+    report = analyze_source(src, name="r2d2_tpu/learner/fx.py",
+                            rules=["donation-discipline"])
+    assert len(report.findings) == 1
+    assert report.findings[0].message.startswith("missed-donation:")
+    assert "state" in report.findings[0].message
+    # neutral path: a serving act fn legitimately never donates
+    assert analyze_source(src, rules=["donation-discipline"]).findings \
+        == []
+    # donating form is clean in scope
+    good = src.replace("jax.jit(train_step)",
+                       "jax.jit(train_step, donate_argnums=(0, 1))")
+    assert analyze_source(good, name="r2d2_tpu/learner/fx.py",
+                          rules=["donation-discipline"]).findings == []
+
+
+def test_missed_donation_bare_decorator_and_trainstate_annotation():
+    report = analyze_source(_src("""
+        import jax
+        from r2d2_tpu.learner.state import TrainState
+
+        @jax.jit
+        def update(ts: TrainState, lr):
+            return ts
+    """), name="r2d2_tpu/parallel/fx.py", rules=["donation-discipline"])
+    assert len(report.findings) == 1
+    assert report.findings[0].message.startswith("missed-donation:")
+    assert "'update'" in report.findings[0].message
+
+
+def test_result_sync_in_loop_functions():
+    report = analyze_source(_src("""
+        import jax
+        import numpy as np
+
+        def f(state, x):
+            return state
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def train_loop(state, xs):
+            for x in xs:
+                state = step(state, x)
+                v = np.asarray(state)       # per-iteration sync
+                state.block_until_ready()   # and again
+            return v
+
+        def harvest_once(state, x):
+            state = step(state, x)
+            return np.asarray(state)        # not a *_loop: out of scope
+    """), rules=["donation-discipline"])
+    sync = [f for f in report.findings
+            if f.message.startswith("result-sync:")]
+    assert len(sync) == 2
+    msgs = " | ".join(f.message for f in sync)
+    assert "np.asarray(state)" in msgs
+    assert ".block_until_ready(state)" in msgs
+
+
+def test_donation_suppressed_with_reason():
+    report = analyze_source(_src("""
+        import jax
+
+        def f(state):
+            return state
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def run(state):
+            out = step(state)
+            return state  # graftlint: disable=donation-discipline -- fixture: host oracle replays inputs
+    """), rules=["donation-discipline"])
+    assert report.findings == [] and len(report.suppressed) == 1
+    assert report.suppressed[0].reason == \
+        "fixture: host oracle replays inputs"
+
+
+# ---------------------------------------------------- transfer-flow
+
+def test_transfer_flow_flags_numpy_cast_of_jitted_result():
+    report = analyze_source(_src("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            return x * 2
+
+        step = jax.jit(f)
+
+        def harvest(x):
+            y = step(x)
+            a = np.asarray(y)                    # implicit D2H
+            b = np.array(step(x))                # direct form
+            c = np.asarray(jax.device_get(y))    # explicit: clean
+            d = np.asarray([1, 2, 3])            # host data: clean
+            return a, b, c, d
+    """), rules=["transfer-flow"])
+    assert len(report.findings) == 2
+    assert all(f.message.startswith("implicit-transfer:")
+               for f in report.findings)
+
+
+def test_transfer_flow_unsharded_device_put_scoped():
+    src = _src("""
+        import jax
+
+        def stage(x, sharding):
+            a = jax.device_put(x)                       # unsharded
+            b = jax.device_put(x, sharding)             # positional: ok
+            c = jax.device_put(x, device=None)          # kwarg: ok
+            return a, b, c
+    """)
+    report = analyze_source(src, name="r2d2_tpu/parallel/fx.py",
+                            rules=["transfer-flow"])
+    assert len(report.findings) == 1
+    assert report.findings[0].message.startswith("unsharded-device-put:")
+    # out of the mesh-aware scopes: silent
+    assert analyze_source(src, rules=["transfer-flow"]).findings == []
+
+
+def test_transfer_flow_host_scalar_loop():
+    report = analyze_source(_src("""
+        import jax
+
+        def f(x):
+            return x.sum()
+
+        step = jax.jit(f)
+
+        def watch_loop(xs):
+            for x in xs:
+                loss = step(x)
+                if float(loss) > 1.0:     # per-iteration scalar D2H
+                    break
+
+        def watch_once(x):
+            return float(step(x))         # not a *_loop: out of scope
+    """), rules=["transfer-flow"])
+    assert len(report.findings) == 1
+    assert report.findings[0].message.startswith("host-scalar-loop:")
+    assert "float(loss)" in report.findings[0].message
+
+
+def test_transfer_flow_suppressed_with_reason():
+    report = analyze_source(_src("""
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda x: x)
+
+        def probe(x):
+            return np.asarray(step(x))  # graftlint: disable=transfer-flow -- fixture: the measured quantity IS the fetch
+    """), rules=["transfer-flow"])
+    assert report.findings == [] and len(report.suppressed) == 1
+
+
+# ---------------------------------------------------- baseline mode
+
+def _mk_report(src: str, name: str = "fixture.py", rules=None):
+    return analyze_source(src, name=name, rules=rules)
+
+
+def test_baseline_snapshot_diff_round_trip(tmp_path):
+    """write → load → diff must be a fixed point; drift in any of the
+    four directions (new/stale finding, new/stale suppression) and a
+    count change each produce a diff line."""
+    from r2d2_tpu.analysis import baseline as bl
+
+    clean = _src("""
+        import threading
+
+        t = threading.Thread(target=f)  # graftlint: disable=thread-discipline -- fixture reason
+    """)
+    rep = _mk_report(clean, rules=["thread-discipline"])
+    p = tmp_path / "base.json"
+    bl.write(str(p), rep)
+    pinned = bl.load(str(p))
+    assert pinned["version"] == bl.BASELINE_VERSION
+    assert pinned["findings"] == []
+    assert pinned["suppressions"] == [
+        {"path": "fixture.py", "rule": "thread-discipline", "count": 1,
+         "reasons": ["fixture reason"]}]
+    assert bl.diff(pinned, rep) == []
+
+    # new unsuppressed finding → drift
+    dirty = clean.replace("  # graftlint: disable=thread-discipline"
+                          " -- fixture reason", "")
+    drift = bl.diff(pinned, _mk_report(dirty, rules=["thread-discipline"]))
+    assert any("new finding" in d for d in drift)
+    assert any("stale baseline suppression" in d for d in drift)
+
+    # suppression count drift → drift
+    doubled = clean + ("u = threading.Thread(target=f)  "
+                       "# graftlint: disable=thread-discipline -- more\n")
+    drift = bl.diff(pinned,
+                    _mk_report(doubled, rules=["thread-discipline"]))
+    assert any("count drift" in d for d in drift)
+
+
+def test_baseline_rejects_version_mismatch(tmp_path):
+    from r2d2_tpu.analysis import baseline as bl
+
+    p = tmp_path / "old.json"
+    p.write_text(json.dumps({"version": 99, "findings": [],
+                             "suppressions": []}))
+    with pytest.raises(ValueError, match="version"):
+        bl.load(str(p))
+
+
+def test_baseline_cli_check_and_write(tmp_path):
+    """--write-baseline then --baseline exits 0; introduce drift (a new
+    suppression the snapshot has never seen) and the check exits 1 with
+    the drift line on stdout."""
+    mod = tmp_path / "fx.py"
+    mod.write_text(_src("""
+        import threading
+
+        t = threading.Thread(target=f)  # graftlint: disable=thread-discipline -- fixture
+    """))
+    base = tmp_path / "base.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "r2d2_tpu.analysis", str(mod),
+         "--write-baseline", str(base)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "r2d2_tpu.analysis", str(mod),
+         "--baseline", str(base)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    mod.write_text(mod.read_text() + (
+        "u = threading.Thread(target=g)  "
+        "# graftlint: disable=thread-discipline -- fixture 2\n"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "r2d2_tpu.analysis", str(mod),
+         "--baseline", str(base)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "count drift" in proc.stdout
+
+
+def test_cli_seeded_use_after_donate_exits_one(tmp_path):
+    """A seeded use-after-donate (the class of bug CPU CI cannot catch
+    at runtime) turns the CLI red with the documented finding code."""
+    bad = tmp_path / "bad_drivetrain.py"
+    bad.write_text(_src("""
+        import jax
+
+        def train(state, batch):
+            return state
+
+        step = jax.jit(train, donate_argnums=(0,))
+
+        def run(state, batch):
+            out = step(state, batch)
+            return state.mean()
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "r2d2_tpu.analysis", str(bad), "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    hits = [f for f in report["findings"]
+            if f["rule"] == "donation-discipline"]
+    assert hits and hits[0]["message"].startswith("use-after-donate:")
+
+
 # ------------------------------------------------------- runtime guards
 
 def test_retrace_guard_reports_deliberate_retrace():
@@ -836,6 +1213,75 @@ def test_transfer_counter_basics():
     assert c.snapshot() == {"serve.act_fetch": 3, "ingest.block": 1}
     c.reset()
     assert c.get("serve.act_fetch") == 0
+
+
+def test_transfer_guard_disarmed_is_inert():
+    """Disarmed (the default), the windows are pure pass-throughs: no
+    jax import, no guard state, no counters."""
+    from r2d2_tpu.utils.trace import TransferGuard
+
+    g = TransferGuard()
+    assert not g.armed
+    with g.disallow("fx.window"):
+        import numpy as _np
+        x = _np.ones(3)
+    with g.allow():
+        pass
+    assert g.snapshot() == {}
+
+
+def test_transfer_guard_trips_on_implicit_h2d():
+    """Armed, an implicit host→device transfer inside a disallow window
+    raises TransferGuardTripped (with the window name) and books the
+    trip counter; the same transfer inside an allowed() span passes.
+    On CPU the H2D side is the enforceable one — device→host is
+    zero-copy there, so D2H enforcement is real only on accelerators."""
+    import jax.numpy as jnp
+
+    from r2d2_tpu.utils.trace import (
+        HOST_TRANSFERS,
+        TRANSFER_GUARD,
+        TransferGuardTripped,
+    )
+
+    # the PROCESS guard: HOST_TRANSFERS.allowed() opens its allow span
+    # on this instance, so the declared-site path must be tested on it
+    g = TRANSFER_GUARD
+    w0 = g.snapshot().get("window.fx.dispatch", 0)
+    t0 = g.snapshot().get("trip.fx.dispatch", 0)
+    with g.arm():
+        assert g.armed
+        with pytest.raises(TransferGuardTripped, match="fx.dispatch"):
+            with g.disallow("fx.dispatch"):
+                jnp.ones(4)            # implicit H2D of a host constant
+        before = HOST_TRANSFERS.get("fx.put")
+        with g.disallow("fx.dispatch"):
+            with HOST_TRANSFERS.allowed("fx.put"):
+                x = jnp.ones(4)        # declared: allowed span
+        assert HOST_TRANSFERS.get("fx.put") == before + 1
+    assert not g.armed
+    snap = g.snapshot()
+    assert snap["window.fx.dispatch"] - w0 == 2
+    assert snap["trip.fx.dispatch"] - t0 == 1
+
+
+def test_transfer_guard_explicit_transfers_exempt():
+    """jax.device_get / device_put are EXPLICIT transfers — exempt under
+    transfer_guard('disallow'), which is exactly why the declared
+    harvest sites use them."""
+    import jax
+    import jax.numpy as jnp
+
+    from r2d2_tpu.utils.trace import TransferGuard
+
+    g = TransferGuard()
+    with g.allow():
+        x = jnp.arange(4.0)
+    with g.arm():
+        with g.disallow("fx.harvest"):
+            v = jax.device_get(x)
+        assert v.shape == (4,)
+    assert g.snapshot().get("trip.fx.harvest", 0) == 0
 
 
 def test_train_sync_stays_within_retrace_budgets():

@@ -515,6 +515,10 @@ def mesh_main() -> int:
 
 
 def main() -> int:
+    from r2d2_tpu.analysis import preflight
+
+    # fail fast on a dirty tree before burning A/B wall-clock
+    preflight(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     if MESH_MODE:
         return mesh_main()
     cells = []

@@ -1,0 +1,224 @@
+"""Slab-path copy audit A/B (r19): pre-fix vs post-fix shapes, interleaved.
+
+The r19 donation/transfer-flow audit replaced two slab-path copy shapes:
+
+- **act-fetch** (serving/batcher.py, parallel/inference_service.py):
+  the serve reply used to materialize ``q`` and ``new_hidden`` with TWO
+  implicit ``np.asarray`` casts — two synchronous D2H crossings per
+  batch.  The fixed shape is ONE explicit
+  ``jax.device_get((q, new_hidden))``: same values, one blocking fetch,
+  and explicit transfers stay exempt under the armed
+  ``jax.transfer_guard("disallow")`` windows.
+- **frame-request** (serving/server.py ``_handle_frame``): every MSG_ACT
+  used to build its Request with ``np.array(views[...])`` — a full copy
+  of the obs slab per frame.  ``FrameReader.poll`` emits each frame as
+  its own immutable ``bytes``, so the decoded views alias stable memory
+  and ``np.asarray`` (zero-copy view) is safe; the fixed shape
+  double-materializes nothing on the ingest path.
+
+Both A/B cells here run the OLD and NEW shape interleaved (A,B,A,B,...)
+on identical inputs, pin bit-exactness every round, and report
+per-call latency.  Writes ``artifacts/r19/COPY_AUDIT_AB_r19.json`` and
+renders ``docs/perf/COPY_AUDIT_r19.md``.
+
+Honest caveat (the BENCH_r05 convention): this is a ~2-core CPU host
+with the jax CPU backend, where D2H is zero-copy — the act-fetch delta
+measured here is dispatch/stall bookkeeping only, a FLOOR on the
+saving; on a real accelerator each removed implicit cast is a removed
+synchronous PCIe/ICI round trip.  The frame-request cell is pure host
+memory traffic and transfers directly.
+"""
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+PATH = "artifacts/r19/COPY_AUDIT_AB_r19.json"
+DOC = "docs/perf/COPY_AUDIT_r19.md"
+
+A = 4
+ROUNDS = 400
+FRAME_ROUNDS = 4000
+
+
+def _cfg():
+    from r2d2_tpu.config import test_config
+
+    return test_config(game_name="Fake", serve_max_batch=8)
+
+
+def act_fetch_cell() -> dict:
+    """Old shape (two implicit np.asarray syncs) vs new shape (one
+    explicit device_get) on the SAME compiled act fn and inputs."""
+    import jax
+
+    from r2d2_tpu.actor import make_act_fn
+    from r2d2_tpu.models.network import create_network, init_params
+
+    cfg = _cfg()
+    net = create_network(cfg, A)
+    params = init_params(cfg, net, jax.random.PRNGKey(0))
+    act = make_act_fn(cfg, net, retrace_budget=2)
+    rng = np.random.default_rng(0)
+    n = 8
+    obs = rng.integers(0, 256, (n, *cfg.stored_obs_shape)).astype(np.uint8)
+    la = rng.random((n, A)).astype(np.float32)
+    lr = rng.random(n).astype(np.float32)
+    hid = (rng.normal(size=(n, 2, cfg.lstm_layers, cfg.hidden_dim))
+           * 0.1).astype(np.float32)
+    act(params, obs, la, lr, hid)  # compile outside the timed region
+
+    old_ns, new_ns = [], []
+    for _ in range(ROUNDS):
+        t0 = time.perf_counter_ns()
+        q, h = act(params, obs, la, lr, hid)
+        qa = np.asarray(q)   # graftlint: disable=transfer-flow -- variant A: the measured quantity IS the pre-r19 implicit double sync
+        ha = np.asarray(h)   # graftlint: disable=transfer-flow -- variant A: the measured quantity IS the pre-r19 implicit double sync
+        old_ns.append(time.perf_counter_ns() - t0)
+
+        t0 = time.perf_counter_ns()
+        q, h = act(params, obs, la, lr, hid)
+        qb, hb = jax.device_get((q, h))
+        new_ns.append(time.perf_counter_ns() - t0)
+        # bit-exactness pin: the audit fix changes HOW the values land
+        # on the host, never the values
+        np.testing.assert_array_equal(qa, qb)
+        np.testing.assert_array_equal(ha, hb)
+
+    def stats(ns):
+        return dict(median_us=round(statistics.median(ns) / 1e3, 2),
+                    p90_us=round(sorted(ns)[int(len(ns) * 0.9)] / 1e3, 2))
+
+    return dict(cell="act_fetch", rounds=ROUNDS, batch=n,
+                old=stats(old_ns), new=stats(new_ns),
+                old_shape="np.asarray(q); np.asarray(h)  (2 implicit syncs)",
+                new_shape="jax.device_get((q, h))  (1 explicit fetch)",
+                bit_exact=True)
+
+
+def frame_request_cell() -> dict:
+    """Old shape (np.array full copies per MSG_ACT frame) vs new shape
+    (np.asarray zero-copy views over the frame's immutable bytes)."""
+    from r2d2_tpu.serving.wire import (
+        MSG_ACT,
+        decode_frame,
+        encode_frame,
+        session_request_spec,
+    )
+
+    cfg = _cfg()
+    spec = session_request_spec(cfg, A)
+    rng = np.random.default_rng(1)
+    fields = dict(
+        obs=rng.integers(0, 256, cfg.stored_obs_shape).astype(np.uint8),
+        last_action=rng.random(A).astype(np.float32),
+        last_reward=rng.random(1).astype(np.float32))
+    frame = encode_frame(spec, (MSG_ACT, 7, 1, 0), fields)
+    body = bytes(frame[4:])  # FrameReader.poll emits per-frame bytes
+
+    old_ns, new_ns = [], []
+    for _ in range(FRAME_ROUNDS):
+        t0 = time.perf_counter_ns()
+        _h, views = decode_frame(spec, body)
+        o1 = np.array(views["obs"])
+        a1 = np.array(views["last_action"])
+        old_ns.append(time.perf_counter_ns() - t0)
+
+        t0 = time.perf_counter_ns()
+        _h, views = decode_frame(spec, body)
+        o2 = np.asarray(views["obs"])
+        a2 = np.asarray(views["last_action"])
+        new_ns.append(time.perf_counter_ns() - t0)
+        np.testing.assert_array_equal(o1, o2)
+        np.testing.assert_array_equal(a1, a2)
+
+    def stats(ns):
+        return dict(median_us=round(statistics.median(ns) / 1e3, 2),
+                    p90_us=round(sorted(ns)[int(len(ns) * 0.9)] / 1e3, 2))
+
+    return dict(cell="frame_request", rounds=FRAME_ROUNDS,
+                obs_shape=list(cfg.stored_obs_shape),
+                old=stats(old_ns), new=stats(new_ns),
+                old_shape="np.array(views[...])  (full obs copy/frame)",
+                new_shape="np.asarray(views[...])  (zero-copy view)",
+                bit_exact=True)
+
+
+def render_doc(data: dict) -> str:
+    lines = [
+        "# Slab-path copy audit A/B — r19",
+        "",
+        "The donation/transfer-flow audit (docs/ANALYSIS.md) replaced "
+        "two copy shapes on serve slab paths; each cell below runs the "
+        "old and new shape INTERLEAVED on identical inputs and pins "
+        "bit-exactness every round.",
+        "",
+        "| cell | old shape | new shape | old median | new median |",
+        "|---|---|---|---|---|",
+    ]
+    for c in data["cells"]:
+        lines.append(
+            f"| {c['cell']} | `{c['old_shape']}` | `{c['new_shape']}` | "
+            f"{c['old']['median_us']} µs | {c['new']['median_us']} µs |")
+    lines += [
+        "",
+        f"Host: {data['host_cpus']} CPUs, backend `{data['backend']}` "
+        f"(recorded {data['recorded_at']}).",
+        "",
+        "**Caveat (BENCH_r05 convention):** ~2-core CPU host.  jax CPU "
+        "D2H is ZERO-COPY, so `np.asarray` of a CPU device buffer is "
+        "nearly free and the act-fetch cell can measure the explicit "
+        "`device_get` SLOWER here (it pays tree-fetch bookkeeping; the "
+        "implicit casts pay nothing on this backend).  That cell's "
+        "motivation is the accelerator contract, not CPU µs: on a real "
+        "chip each implicit `np.asarray` is a separate synchronous "
+        "device→host round trip (two per batch in the old shape), and "
+        "only the explicit form is exempt under the armed "
+        "`jax.transfer_guard(\"disallow\")` windows — the CPU number is "
+        "the bookkeeping cost of that enforcement, not the saving.  The "
+        "frame-request cell is pure host memory traffic and transfers "
+        "directly.  Audit keeps "
+        "(copies that are load-bearing and stayed): sum_tree snapshot/"
+        "sample copies (detach from the live ring), replay_net recv-slab "
+        "copies (reused buffer), inference_service hidden snapshot "
+        "(consistent read under lock), telemetry slab copy (CRC "
+        "torn-write detection).",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def main() -> int:
+    from r2d2_tpu.analysis import preflight
+
+    preflight(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import datetime
+
+    import jax
+
+    cells = [act_fetch_cell(), frame_request_cell()]
+    data = dict(
+        kind="copy_audit_ab_r19",
+        recorded_at=datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+        host_cpus=os.cpu_count(), backend=jax.default_backend(),
+        cells=cells)
+    os.makedirs(os.path.dirname(PATH), exist_ok=True)
+    with open(PATH, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+    os.makedirs(os.path.dirname(DOC), exist_ok=True)
+    with open(DOC, "w") as f:
+        f.write(render_doc(data))
+    for c in cells:
+        print(f"{c['cell']}: old {c['old']['median_us']}us -> "
+              f"new {c['new']['median_us']}us (bit-exact)", flush=True)
+    print(f"wrote {PATH} and {DOC}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,6 +212,10 @@ import jax.numpy as jnp  # noqa: E402
 
 
 def main() -> int:
+    from r2d2_tpu.analysis import preflight
+
+    # fail fast on a dirty tree before burning bench wall-clock
+    preflight(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     runlog = artifact_log(OUT, "pjit_bench_telemetry.jsonl")
     started = time.time()
